@@ -1,0 +1,4 @@
+from baton_tpu.core.model import FedModel
+from baton_tpu.core.training import LocalTrainer, make_local_trainer
+
+__all__ = ["FedModel", "LocalTrainer", "make_local_trainer"]

@@ -1,0 +1,42 @@
+"""Per-example loss builders.
+
+The reference hard-codes ``nn.MSELoss`` in the demo training loop
+(reference: demo.py:31,44). Here losses are pluggable, per-example (see
+:mod:`baton_tpu.core.model` for why), and written so XLA fuses them into
+the backward matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mse(outputs: jax.Array, batch, rng) -> jax.Array:
+    """Per-example mean-squared error. outputs [B, ...], batch["y"] same."""
+    y = batch["y"]
+    if outputs.ndim > y.ndim:
+        outputs = outputs.squeeze(-1)
+    err = (outputs - y).astype(jnp.float32)
+    if err.ndim == 1:
+        return err * err
+    return jnp.mean(err * err, axis=tuple(range(1, err.ndim)))
+
+
+def softmax_cross_entropy(logits: jax.Array, batch, rng) -> jax.Array:
+    """Per-example cross entropy with integer labels batch["y"] [B]."""
+    labels = batch["y"]
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits.astype(jnp.float32), labels[..., None], axis=-1
+    ).squeeze(-1)
+    return logz - label_logits
+
+
+def sigmoid_binary_cross_entropy(logits: jax.Array, batch, rng) -> jax.Array:
+    """Per-example binary cross entropy, batch["y"] in {0,1} [B]."""
+    y = batch["y"].astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if logits.ndim > y.ndim:
+        logits = logits.squeeze(-1)
+    return jnp.maximum(logits, 0) - logits * y + jnp.log1p(jnp.exp(-jnp.abs(logits)))

@@ -1,0 +1,201 @@
+"""Jitted local training — the TPU replacement for the reference hot loop.
+
+The reference's local training is a Python for-loop over epochs and
+batches doing zero_grad/forward/MSE/backward/step on the worker's event
+loop (reference: demo.py:29-49, worker.py:103-106 — it even blocks
+heartbeats, SURVEY §2.9 item 7). Here the *entire* multi-epoch run is one
+XLA program: ``lax.scan`` over epochs, ``lax.scan`` over batches, optax
+update inline — so it can be vmapped over thousands of simulated clients
+and sharded over a TPU mesh with zero Python in the hot path.
+
+Static-shape discipline (XLA): client datasets are padded to a fixed
+``capacity`` divisible by ``batch_size``; a per-row validity mask derived
+from the *dynamic* ``n_samples`` scalar zeroes the loss/grad contribution
+of padding exactly. Shuffling is a ``jax.random.permutation`` of row
+indices per epoch (replaces torch.randperm, demo.py:33).
+
+Loss accounting fixes the reference's biased running mean (utils.py:85-88,
+SURVEY §2.6): per-epoch loss is the exact sample-weighted mean
+``Σ loss_i / n_samples`` over real examples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from baton_tpu.core.model import Batch, FedModel, Params, PRNGKey
+
+Regularizer = Callable[[Params, Params], jax.Array]
+
+
+def num_batches(capacity: int, batch_size: int) -> int:
+    if capacity % batch_size != 0:
+        raise ValueError(
+            f"padded capacity {capacity} must be divisible by batch_size {batch_size}; "
+            "use baton_tpu.ops.padding.pad_dataset"
+        )
+    return capacity // batch_size
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalTrainer:
+    """Compiled multi-epoch local training for one client.
+
+    ``train(params, data, n_samples, rng, n_epochs)`` returns
+    ``(params, opt_state, loss_history[n_epochs])``. ``data`` is a dict of
+    arrays padded to a static capacity; ``n_samples`` is the dynamic count
+    of real rows (the same number that weights this client in FedAvg,
+    reference manager.py:119-126).
+
+    When ``regularizer`` is set, ``train`` takes an ``anchor`` params
+    pytree and the local objective becomes ``data_loss + regularizer(
+    params, anchor)`` — the pluggable local-objective hook used for
+    FedProx (anchor = the round's global params).
+    """
+
+    model: FedModel
+    optimizer: optax.GradientTransformation
+    batch_size: int
+    regularizer: Optional[Regularizer] = None
+
+    def init_opt_state(self, params: Params):
+        return self.optimizer.init(params)
+
+    @partial(jax.jit, static_argnums=(0, 5))
+    def train(
+        self,
+        params: Params,
+        data: Batch,
+        n_samples: jax.Array,
+        rng: PRNGKey,
+        n_epochs: int,
+        anchor: Optional[Params] = None,
+    ):
+        opt_state = self.optimizer.init(params)
+        return self.train_with_opt_state(
+            params, opt_state, data, n_samples, rng, n_epochs, anchor
+        )
+
+    @partial(jax.jit, static_argnums=(0, 6))
+    def train_with_opt_state(
+        self,
+        params: Params,
+        opt_state,
+        data: Batch,
+        n_samples: jax.Array,
+        rng: PRNGKey,
+        n_epochs: int,
+        anchor: Optional[Params] = None,
+    ):
+        """Same as ``train`` but threads optimizer state (for stateful
+        local optimizers persisted across rounds, or wave scheduling)."""
+        leaves = jax.tree_util.tree_leaves(data)
+        capacity = leaves[0].shape[0]
+        nb = num_batches(capacity, self.batch_size)
+        n_samples = jnp.asarray(n_samples, jnp.int32)
+
+        def objective(p, batch, step_rng):
+            data_loss_sum, count = self.model.loss_and_count(p, batch, step_rng)
+            denom = jnp.maximum(count, 1.0)
+            loss = data_loss_sum / denom
+            if self.regularizer is not None:
+                loss = loss + self.regularizer(p, anchor)
+            return loss, (data_loss_sum, count)
+
+        grad_fn = jax.value_and_grad(objective, has_aux=True)
+
+        def batch_step(carry, batch):
+            p, os, step_rng = carry
+            step_rng, sub = jax.random.split(step_rng)
+            (_, (loss_sum, count)), grads = grad_fn(p, batch, sub)
+            # An all-padding batch yields exactly-zero grads; gate the
+            # update so stateful optimizers (momentum/adam) don't mutate
+            # state on phantom steps.
+            nonempty = count > 0
+            updates, new_os = self.optimizer.update(grads, os, p)
+            new_p = optax.apply_updates(p, updates)
+            p = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(nonempty, new, old), new_p, p
+            )
+            os = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(nonempty, new, old), new_os, os
+            )
+            return (p, os, step_rng), (loss_sum, count)
+
+        def epoch_step(carry, epoch_rng):
+            p, os = carry
+            perm_rng, step_rng = jax.random.split(epoch_rng)
+            perm = jax.random.permutation(perm_rng, capacity)
+            mask = (perm < n_samples).astype(jnp.float32)
+            shuffled = jax.tree_util.tree_map(lambda a: jnp.take(a, perm, axis=0), data)
+            shuffled = dict(shuffled)
+            if "mask" in shuffled:
+                mask = mask * shuffled["mask"].astype(jnp.float32)
+            shuffled["mask"] = mask
+            batched = jax.tree_util.tree_map(
+                lambda a: a.reshape((nb, self.batch_size) + a.shape[1:]), shuffled
+            )
+            (p, os, _), (loss_sums, counts) = jax.lax.scan(
+                batch_step, (p, os, step_rng), batched
+            )
+            total = jnp.maximum(jnp.sum(counts), 1.0)
+            epoch_loss = jnp.sum(loss_sums) / total
+            return (p, os), epoch_loss
+
+        epoch_rngs = jax.random.split(rng, n_epochs)
+        (params, opt_state), loss_history = jax.lax.scan(
+            epoch_step, (params, opt_state), epoch_rngs
+        )
+        return params, opt_state, loss_history
+
+
+def make_local_trainer(
+    model: FedModel,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    batch_size: int = 32,
+    learning_rate: float = 1e-3,
+    regularizer: Optional[Regularizer] = None,
+) -> LocalTrainer:
+    """Build a :class:`LocalTrainer`.
+
+    Defaults mirror the reference demo: SGD, lr=0.001, batch_size=32
+    (reference: demo.py:29,34).
+    """
+    if optimizer is None:
+        optimizer = optax.sgd(learning_rate)
+    return LocalTrainer(
+        model=model,
+        optimizer=optimizer,
+        batch_size=batch_size,
+        regularizer=regularizer,
+    )
+
+
+def make_evaluator(model: FedModel):
+    """Jitted full-dataset evaluation: mean loss (+accuracy for int labels).
+    The whole eval set goes through one apply; shard or chunk large sets
+    at the call site."""
+
+    @jax.jit
+    def evaluate(params: Params, data: Batch, rng: PRNGKey):
+        losses = model.per_example_loss(params, data, rng)
+        mask = data.get("mask")
+        if mask is None:
+            mask = jnp.ones_like(losses)
+        mask = mask.astype(jnp.float32)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        out = {"loss": jnp.sum(losses * mask) / denom}
+        y = data.get("y")
+        if y is not None and jnp.issubdtype(y.dtype, jnp.integer):
+            logits = model.apply(params, data, rng)
+            correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+            out["accuracy"] = jnp.sum(correct * mask) / denom
+        return out
+
+    return evaluate

@@ -1,0 +1,12 @@
+from baton_tpu.data.synthetic import (
+    linear_client_data,
+    synthetic_classification_clients,
+)
+from baton_tpu.data.partition import iid_partition, dirichlet_partition
+
+__all__ = [
+    "linear_client_data",
+    "synthetic_classification_clients",
+    "iid_partition",
+    "dirichlet_partition",
+]

@@ -1,0 +1,69 @@
+"""Dataset partitioners: IID and Dirichlet non-IID label-skew shards.
+
+Not in the reference (each baton worker invents its own data,
+demo.py:52-59); required by the BASELINE configs ("128 non-IID clients
+(Dirichlet shards)"). The Dirichlet scheme is the standard label-skew
+protocol: for each client draw p ~ Dir(alpha·1_K) over classes and sample
+its shard accordingly; alpha→∞ is IID, alpha→0 is one-class clients.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def iid_partition(
+    data: Dict[str, np.ndarray], n_clients: int, rng: np.random.Generator
+) -> List[Dict[str, np.ndarray]]:
+    n = next(iter(data.values())).shape[0]
+    perm = rng.permutation(n)
+    shards = np.array_split(perm, n_clients)
+    return [{k: v[idx] for k, v in data.items()} for idx in shards]
+
+
+def dirichlet_partition(
+    data: Dict[str, np.ndarray],
+    n_clients: int,
+    rng: np.random.Generator,
+    alpha: float = 0.5,
+    label_key: str = "y",
+    min_samples: int = 1,
+) -> List[Dict[str, np.ndarray]]:
+    """Label-skew Dirichlet partition of a labelled dataset."""
+    y = np.asarray(data[label_key])
+    classes = np.unique(y)
+    idx_by_class = {c: rng.permutation(np.flatnonzero(y == c)) for c in classes}
+    client_indices: List[List[int]] = [[] for _ in range(n_clients)]
+    for c in classes:
+        idx = idx_by_class[c]
+        props = rng.dirichlet(np.full(n_clients, alpha))
+        # convert proportions to contiguous split points over this class
+        cuts = (np.cumsum(props) * len(idx)).astype(int)[:-1]
+        for client_i, chunk in enumerate(np.split(idx, cuts)):
+            client_indices[client_i].extend(chunk.tolist())
+    # Rebalance BEFORE materializing any shard so stolen rows move (not
+    # duplicate) between clients.
+    for ci in client_indices:
+        if len(ci) < min_samples:
+            largest = max(range(n_clients), key=lambda i: len(client_indices[i]))
+            need = min_samples - len(ci)
+            ci.extend(client_indices[largest][-need:])
+            del client_indices[largest][-need:]
+    shards = []
+    for ci in client_indices:
+        arr = np.asarray(ci, dtype=np.int64)
+        rng.shuffle(arr)
+        shards.append({k: v[arr] for k, v in data.items()})
+    return shards
+
+
+def partition_stats(shards: List[Dict[str, np.ndarray]], label_key: str = "y"):
+    """Per-shard (size, label histogram) — observability for non-IID runs."""
+    stats = []
+    for s in shards:
+        y = np.asarray(s[label_key])
+        vals, counts = np.unique(y, return_counts=True)
+        stats.append({"n": int(y.shape[0]), "labels": dict(zip(vals.tolist(), counts.tolist()))})
+    return stats

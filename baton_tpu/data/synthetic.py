@@ -1,0 +1,78 @@
+"""Synthetic datasets.
+
+``linear_client_data`` mirrors the reference demo's per-client data draw:
+``32·randint(5,20)`` samples of ``y = p·X`` for a fixed 10-dim coefficient
+vector (reference: demo.py:52-59) — including the ragged per-client sizes
+that exercise the padding/masking machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+# The reference demo's fixed coefficient vector (demo.py:55).
+DEMO_COEF = np.array([11, 5, 3, 2, 5, 6, 2, 7, 8, 1], dtype=np.float32)
+
+
+def linear_client_data(
+    rng: np.random.Generator,
+    coef: Optional[np.ndarray] = None,
+    noise: float = 0.0,
+    min_batches: int = 5,
+    max_batches: int = 20,
+    batch_size: int = 32,
+):
+    """One client's dataset: ``{"x","y"}`` with 32·U[5,20] rows."""
+    coef = DEMO_COEF if coef is None else np.asarray(coef, np.float32)
+    n = batch_size * int(rng.integers(min_batches, max_batches + 1))
+    x = rng.standard_normal((n, coef.shape[0])).astype(np.float32)
+    y = x @ coef
+    if noise:
+        y = y + noise * rng.standard_normal(n).astype(np.float32)
+    return {"x": x, "y": y.astype(np.float32)}
+
+
+def synthetic_classification_clients(
+    rng: np.random.Generator,
+    n_clients: int,
+    n_per_client: int = 128,
+    in_dim: int = 32,
+    n_classes: int = 10,
+    ragged: bool = True,
+) -> Tuple[list, np.ndarray]:
+    """Linearly-separable-ish classification shards for engine tests."""
+    w = rng.standard_normal((in_dim, n_classes)).astype(np.float32)
+    datasets = []
+    for _ in range(n_clients):
+        n = n_per_client
+        if ragged:
+            n = int(rng.integers(n_per_client // 2, n_per_client + 1))
+        x = rng.standard_normal((n, in_dim)).astype(np.float32)
+        logits = x @ w + 0.5 * rng.standard_normal((n, n_classes)).astype(np.float32)
+        y = np.argmax(logits, axis=-1).astype(np.int32)
+        datasets.append({"x": x, "y": y})
+    return datasets, w
+
+
+def synthetic_image_clients(
+    rng: np.random.Generator,
+    n_clients: int,
+    n_per_client: int = 64,
+    image_size: int = 28,
+    channels: int = 1,
+    n_classes: int = 10,
+):
+    """MNIST-shaped synthetic image shards (class-dependent mean patches)."""
+    protos = rng.standard_normal((n_classes, image_size, image_size, channels)).astype(
+        np.float32
+    )
+    datasets = []
+    for _ in range(n_clients):
+        y = rng.integers(0, n_classes, size=n_per_client).astype(np.int32)
+        x = protos[y] + 0.5 * rng.standard_normal(
+            (n_per_client, image_size, image_size, channels)
+        ).astype(np.float32)
+        datasets.append({"x": x, "y": y})
+    return datasets

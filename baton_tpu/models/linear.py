@@ -1,0 +1,33 @@
+"""Linear regression — the demo-parity model.
+
+The reference demo model is a 10→1 ``nn.Linear`` trained with MSE + SGD
+(reference: demo.py:15-49, name "lineartest" at demo.py:16). Here it is
+a pure-functional FedModel: params are ``{"w": [d,1], "b": [1]}``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from baton_tpu.core.losses import mse
+from baton_tpu.core.model import FedModel
+
+
+def linear_regression_model(in_dim: int = 10, name: str = "lineartest") -> FedModel:
+    def init(rng):
+        wkey, _ = jax.random.split(rng)
+        # Match torch.nn.Linear's default U(-1/sqrt(d), 1/sqrt(d)) scale.
+        bound = 1.0 / jnp.sqrt(in_dim)
+        return {
+            "w": jax.random.uniform(wkey, (in_dim, 1), jnp.float32, -bound, bound),
+            "b": jnp.zeros((1,), jnp.float32),
+        }
+
+    def apply(params, batch, rng):
+        return batch["x"] @ params["w"] + params["b"]
+
+    def per_example_loss(params, batch, rng):
+        return mse(apply(params, batch, rng), batch, rng)
+
+    return FedModel(init=init, apply=apply, per_example_loss=per_example_loss, name=name)

@@ -1,0 +1,47 @@
+"""Small MLP classifier (pure-functional, no flax needed)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from baton_tpu.core.losses import softmax_cross_entropy
+from baton_tpu.core.model import FedModel
+
+
+def mlp_classifier_model(
+    in_dim: int,
+    hidden: Sequence[int] = (64,),
+    n_classes: int = 10,
+    name: str = "mlp",
+) -> FedModel:
+    dims = [in_dim, *hidden, n_classes]
+
+    def init(rng):
+        params = []
+        for i in range(len(dims) - 1):
+            rng, sub = jax.random.split(rng)
+            scale = jnp.sqrt(2.0 / dims[i])
+            params.append(
+                {
+                    "w": jax.random.normal(sub, (dims[i], dims[i + 1]), jnp.float32)
+                    * scale,
+                    "b": jnp.zeros((dims[i + 1],), jnp.float32),
+                }
+            )
+        return params
+
+    def apply(params, batch, rng):
+        h = batch["x"].reshape(batch["x"].shape[0], -1)
+        for i, layer in enumerate(params):
+            h = h @ layer["w"] + layer["b"]
+            if i < len(params) - 1:
+                h = jax.nn.relu(h)
+        return h
+
+    def per_example_loss(params, batch, rng):
+        return softmax_cross_entropy(apply(params, batch, rng), batch, rng)
+
+    return FedModel(init=init, apply=apply, per_example_loss=per_example_loss, name=name)

@@ -1,0 +1,18 @@
+from baton_tpu.ops.aggregation import (
+    weighted_tree_mean,
+    weighted_tree_sum,
+    psum_weighted_mean,
+    tree_stack,
+    tree_unstack,
+)
+from baton_tpu.ops.padding import pad_dataset, pad_to_capacity
+
+__all__ = [
+    "weighted_tree_mean",
+    "weighted_tree_sum",
+    "psum_weighted_mean",
+    "tree_stack",
+    "tree_unstack",
+    "pad_dataset",
+    "pad_to_capacity",
+]

@@ -1,0 +1,10 @@
+from baton_tpu.parallel.mesh import make_mesh, client_sharding, replicated_sharding
+from baton_tpu.parallel.engine import FedSim, RoundResult
+
+__all__ = [
+    "make_mesh",
+    "client_sharding",
+    "replicated_sharding",
+    "FedSim",
+    "RoundResult",
+]

@@ -1,0 +1,28 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip logic (shard_map over Mesh(('clients',))) is tested without
+TPU hardware by splitting the host CPU into 8 XLA devices (SURVEY §4d).
+The platform override must go through jax.config (the environment's TPU
+bootstrap pins JAX_PLATFORMS), and XLA_FLAGS must be set before the
+backend initializes.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def nprng():
+    return np.random.default_rng(0)
