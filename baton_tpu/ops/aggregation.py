@@ -45,13 +45,18 @@ def tree_unstack(tree: Params) -> list:
 
 
 def weighted_tree_sum(stacked: Params, weights: jax.Array) -> Params:
-    """``Σ_c w_c · leaf[c]`` for every leaf of a ``[C, ...]``-stacked tree."""
+    """``Σ_c w_c · leaf[c]`` for every leaf of a ``[C, ...]``-stacked tree.
+
+    Returns fp32 leaves regardless of input dtype: these are partial
+    sums destined for further accumulation (waves, psum) — casting back
+    to bf16/fp16 here would lose the fp32 accumulation guarantee and can
+    overflow fp16 at realistic sample counts. Callers cast the final
+    mean back to the param dtype.
+    """
     w = weights.astype(jnp.float32)
 
     def one(leaf):
-        return jnp.tensordot(w, leaf.astype(jnp.float32), axes=(0, 0)).astype(
-            leaf.dtype
-        )
+        return jnp.tensordot(w, leaf.astype(jnp.float32), axes=(0, 0))
 
     return jax.tree_util.tree_map(one, stacked)
 

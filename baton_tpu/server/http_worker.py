@@ -1,0 +1,240 @@
+"""HTTP worker runtime — a real (non-simulated) federated client.
+
+Reference counterpart: worker.py:12-127. Same lifecycle — register with
+the manager, heartbeat on a period, accept ``round_start`` broadcasts,
+train locally, POST the result to ``update`` — with the recorded fixes
+(SURVEY §2.9):
+
+* item 5 FIXED — ``round_in_progress`` is actually set/cleared, so the
+  409 duplicate-round guard works (it was dead code in the reference).
+* item 7 FIXED — training runs via ``asyncio.to_thread`` (and the XLA
+  dispatch releases the GIL), so heartbeats keep flowing mid-round; the
+  reference blocked its event loop for the whole local run.
+* Heartbeat backoff is capped exponential (reference doubled unboundedly,
+  worker.py:78 ``# TODO: better backoff``).
+* Weights travel as BTW1 tensors, not pickles (pickle decode opt-in).
+
+The training itself is the TPU path: a :class:`LocalTrainer` jitted
+multi-epoch run — the reference's Python epoch loop (demo.py:29-49)
+compiled into one XLA program.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional, Tuple
+
+import aiohttp
+from aiohttp import web
+import jax
+import numpy as np
+
+from baton_tpu.core.model import FedModel
+from baton_tpu.core.training import LocalTrainer, make_local_trainer
+from baton_tpu.ops.padding import pad_dataset, round_up
+from baton_tpu.server import wire
+from baton_tpu.server.state import params_to_state_dict, state_dict_to_params
+from baton_tpu.server.utils import PeriodicTask
+
+GetData = Callable[[], Tuple[dict, int]]
+MAX_BACKOFF = 60.0
+
+
+class ExperimentWorker:
+    """Subclass and implement ``get_data() -> (data_dict, n_samples)``
+    (reference worker.py:126-127), or pass ``get_data=`` callable."""
+
+    def __init__(
+        self,
+        app: web.Application,
+        model: FedModel,
+        manager: str,
+        name: Optional[str] = None,
+        port: int = 8080,
+        heartbeat_time: float = 60.0,
+        worker_host: Optional[str] = None,
+        trainer: Optional[LocalTrainer] = None,
+        get_data: Optional[GetData] = None,
+        allow_pickle: bool = False,
+        rng_seed: int = 0,
+        auto_register: bool = True,
+    ):
+        self.name = name or getattr(model, "name", "fedmodel")
+        self.model = model
+        self.trainer = trainer or make_local_trainer(model)
+        self.app = app
+        self.port = port
+        self.worker_host = worker_host
+        self.manager = manager
+        self.manager_url = f"http://{manager}/{self.name}/"
+        self.allow_pickle = allow_pickle
+        if get_data is not None:
+            self.get_data = get_data  # type: ignore[assignment]
+
+        self.params = model.init(jax.random.key(rng_seed))
+        self.rng = jax.random.key(rng_seed + 1)
+
+        self.client_id: Optional[str] = None
+        self.key: Optional[str] = None
+        self.n_updates = 0
+        self.round_in_progress = False
+        self.last_update: Optional[str] = None
+        self.heartbeat_time = heartbeat_time
+        self._heartbeat_task: Optional[PeriodicTask] = None
+        self._register_lock = asyncio.Lock()
+        self.__session: Optional[aiohttp.ClientSession] = None
+
+        app.router.add_post(f"/{self.name}/round_start", self.handle_round_start)
+        if auto_register:
+            app.on_startup.append(self._on_startup)
+            app.on_cleanup.append(self._on_cleanup)
+
+    async def _on_startup(self, app=None) -> None:
+        asyncio.ensure_future(self.register_with_manager())
+
+    async def _on_cleanup(self, app=None) -> None:
+        if self._heartbeat_task is not None:
+            await self._heartbeat_task.stop()
+        if self.__session is not None:
+            await self.__session.close()
+
+    @property
+    def _session(self) -> aiohttp.ClientSession:
+        if self.__session is None:
+            self.__session = aiohttp.ClientSession()
+        return self.__session
+
+    # -- membership ----------------------------------------------------
+    async def register_with_manager(self) -> None:
+        if self._register_lock.locked():
+            return  # collision guard (reference ensure_no_collision, per-instance now)
+        async with self._register_lock:
+            url = self.manager_url + "register"
+            payload = {"url": self.worker_host, "port": self.port}
+            backoff = 1.0
+            while True:
+                try:
+                    async with self._session.get(url, json=payload) as resp:
+                        data = await resp.json()
+                        self.client_id = data["client_id"]
+                        self.key = data["key"]
+                        break
+                except aiohttp.ClientError:
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, MAX_BACKOFF)
+            # (Re)start the heartbeat loop — unless we're being called
+            # FROM it (401 -> re-register path): stopping would cancel
+            # the current task ("Task cannot await on itself") and kill
+            # heartbeating permanently. The running loop just continues.
+            hb = self._heartbeat_task
+            inside_heartbeat = (
+                hb is not None and hb._task is asyncio.current_task()
+            )
+            if not inside_heartbeat:
+                if hb is not None:
+                    await hb.stop()
+                self._heartbeat_task = PeriodicTask(
+                    self.heartbeat, self.heartbeat_time
+                ).start()
+
+    async def heartbeat(self) -> None:
+        url = self.manager_url + "heartbeat"
+        backoff = 1.0
+        while True:
+            try:
+                async with self._session.get(
+                    url, json={"client_id": self.client_id, "key": self.key}
+                ) as resp:
+                    if resp.status == 200:
+                        return
+                    if resp.status == 401:
+                        # manager restarted or culled us: rejoin
+                        return await self.register_with_manager()
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, MAX_BACKOFF)
+
+    # -- rounds --------------------------------------------------------
+    async def handle_round_start(self, request: web.Request) -> web.Response:
+        if self.round_in_progress:
+            return web.json_response({"err": "Update in Progress"}, status=409)
+        if (
+            request.query.get("client_id") != self.client_id
+            or request.query.get("key") != self.key
+        ):
+            asyncio.ensure_future(self.register_with_manager())
+            return web.json_response({"err": "Wrong Client"}, status=404)
+        body = await request.read()
+        try:
+            tensors, meta = wire.decode_any(
+                body, request.content_type, allow_pickle=self.allow_pickle
+            )
+            round_name = meta["update_name"]
+            n_epoch = int(meta["n_epoch"])
+            new_params = state_dict_to_params(self.params, tensors)
+        except Exception:
+            # reject before mutating any state: a bad broadcast must not
+            # leave the worker with half-loaded params
+            return web.json_response({"err": "Bad Payload"}, status=400)
+        self.params = new_params
+        self.last_update = round_name
+        self.round_in_progress = True
+        asyncio.ensure_future(self._run_round(round_name, n_epoch))
+        return web.json_response("OK")
+
+    async def _run_round(self, round_name: str, n_epoch: int) -> None:
+        try:
+            data, n_samples = self.get_data()
+            self.rng, sub = jax.random.split(self.rng)
+
+            def train():
+                capacity = round_up(
+                    next(iter(data.values())).shape[0], self.trainer.batch_size
+                )
+                padded, n = pad_dataset(
+                    {k: np.asarray(v) for k, v in data.items()}, capacity
+                )
+                assert n == n_samples or n_samples <= n
+                params, _, losses = self.trainer.train(
+                    self.params, padded, np.int32(n_samples), sub, n_epoch
+                )
+                return params, np.asarray(losses)
+
+            params, loss_history = await asyncio.to_thread(train)
+            self.params = params
+            await self.report_update(round_name, n_samples, loss_history)
+        finally:
+            self.round_in_progress = False
+
+    async def report_update(
+        self, round_name: str, n_samples: int, loss_history
+    ) -> None:
+        url = (
+            self.manager_url
+            + f"update?client_id={self.client_id}&key={self.key}"
+        )
+        body = wire.encode(
+            params_to_state_dict(self.params),
+            {
+                "update_name": round_name,
+                "n_samples": int(n_samples),
+                "loss_history": [float(x) for x in loss_history],
+            },
+        )
+        try:
+            async with self._session.post(
+                url, data=body, headers={"Content-Type": wire.CONTENT_TYPE}
+            ) as resp:
+                if resp.status == 200:
+                    self.n_updates += 1
+                elif resp.status == 401:
+                    await self.register_with_manager()
+                # 410: reported a stale round; nothing to do (parity with
+                # reference worker.py:123-124)
+        except aiohttp.ClientError:
+            pass  # manager down; heartbeat loop will re-establish contact
+
+    # ------------------------------------------------------------------
+    def get_data(self) -> Tuple[dict, int]:
+        raise NotImplementedError

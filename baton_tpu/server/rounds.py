@@ -1,0 +1,127 @@
+"""Round state machine — pure, clock-injected, asyncio-free.
+
+Reference counterpart: update_manager.py:17-68, where the round state is
+literally an ``asyncio.Lock`` (``in_progress == lock.locked()``,
+update_manager.py:31-33). Here the state is explicit data, so the machine
+is unit-testable without an event loop and cannot leak a lock.
+
+Deliberate fixes over the reference (SURVEY §2.9, keep/fix record):
+* item 3 FIXED — aborting a round (e.g. zero clients accepted) resets
+  state; the reference left the lock held when zero clients were
+  *registered*, 423-ing every later round.
+* item 4 FIXED — ``drop_client`` removes a dead client from the running
+  round so ``clients_left`` can reach zero, and ``deadline``/``is_expired``
+  give rounds a straggler timeout. The reference round hung forever if a
+  participant died mid-round.
+* Round naming KEPT: ``update_{name}_{:05d}`` (update_manager.py:26).
+* Exception hierarchy KEPT: RoundError/RoundInProgress/RoundNotInProgress
+  mirror UpdateException/UpdateInProgress/UpdateNotInProgress
+  (update_manager.py:5-14).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Set
+
+from baton_tpu.server.utils import random_key
+
+
+class RoundError(Exception):
+    pass
+
+
+class RoundInProgress(RoundError):
+    pass
+
+
+class RoundNotInProgress(RoundError):
+    pass
+
+
+class RoundManager:
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        round_timeout: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.name = name or random_key(6)
+        self.round_timeout = round_timeout
+        self._clock = clock
+        self.loss_history: list = []
+        self.n_rounds = 0
+        self._in_progress = False
+        self._reset_state()
+
+    def _reset_state(self) -> None:
+        self.round_name = f"update_{self.name}_{self.n_rounds:05d}"
+        self.clients: Set[str] = set()
+        self.client_responses: Dict[str, Any] = {}
+        self.round_meta: Optional[dict] = None
+        self.started_at: Optional[float] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def in_progress(self) -> bool:
+        return self._in_progress
+
+    @property
+    def clients_left(self) -> int:
+        return len(self.clients) - len(self.client_responses)
+
+    @property
+    def is_expired(self) -> bool:
+        """True when the running round has outlived ``round_timeout``."""
+        if not self._in_progress or self.round_timeout is None:
+            return False
+        return (self._clock() - self.started_at) > self.round_timeout
+
+    def __len__(self) -> int:
+        return len(self.clients) if self._in_progress else 0
+
+    # ------------------------------------------------------------------
+    def start_round(self, **round_meta: Any) -> str:
+        if self._in_progress:
+            raise RoundInProgress(self.round_name)
+        self._reset_state()
+        self._in_progress = True
+        self.round_meta = round_meta
+        self.started_at = self._clock()
+        return self.round_name
+
+    def client_start(self, client_id: str) -> None:
+        if not self._in_progress:
+            raise RoundNotInProgress
+        self.clients.add(client_id)
+
+    def client_end(self, client_id: str, response: Any) -> None:
+        if not self._in_progress:
+            raise RoundNotInProgress
+        self.client_responses[client_id] = response
+
+    def drop_client(self, client_id: str) -> None:
+        """Remove a participant mid-round (culled/evicted client) so the
+        round can complete without it."""
+        if not self._in_progress:
+            return
+        self.clients.discard(client_id)
+        self.client_responses.pop(client_id, None)
+
+    def end_round(self) -> Dict[str, Any]:
+        """Finish the round, returning ``{client_id: response}`` for all
+        clients that reported (possibly partial on timeout)."""
+        if not self._in_progress:
+            raise RoundNotInProgress
+        self._in_progress = False
+        self.n_rounds += 1
+        return self.client_responses
+
+    def abort_round(self) -> None:
+        """Cancel a round without counting it (e.g. no client accepted
+        the broadcast — reference manager.py:90-92 path, minus the
+        zero-registered-clients lock leak)."""
+        if not self._in_progress:
+            return
+        self._in_progress = False
+        self._reset_state()

@@ -1,0 +1,54 @@
+"""Bridge between params pytrees and flat named state dicts.
+
+The wire protocol and the reference semantics (manager.py:119-126) speak
+flat ``{name: tensor}`` state dicts; the TPU core speaks pytrees. Names
+are slash-joined tree paths (``"conv1/w"``), stable across processes for
+any JSON-style pytree (dicts/lists/tuples of arrays).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+Params = Any
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def params_to_state_dict(params: Params) -> Dict[str, np.ndarray]:
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    return {_path_str(path): np.asarray(leaf) for path, leaf in flat}
+
+
+def state_dict_to_params(template: Params, state: Dict[str, np.ndarray]) -> Params:
+    """Rebuild a pytree shaped like ``template`` from a flat state dict.
+
+    Raises KeyError on missing tensors and ValueError on shape mismatch —
+    a malformed upload must not corrupt the global model.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        name = _path_str(path)
+        if name not in state:
+            raise KeyError(f"state dict missing tensor {name!r}")
+        arr = np.asarray(state[name])
+        if tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(
+                f"tensor {name!r} has shape {arr.shape}, expected {tuple(leaf.shape)}"
+            )
+        leaves.append(arr.astype(leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,101 @@
+"""Control-plane utilities.
+
+Replaces reference utils.py with deliberate fixes (SURVEY §2.9 decisions):
+
+* ``random_key`` — cryptographic (``secrets``), any length, with
+  replacement. The reference used ``random.sample(ascii_letters, n)``:
+  non-crypto, no repeated chars, max length 52 (utils.py:38-39). FIXED.
+* ``json_clean`` — same semantics as utils.py:23-35: strips ``key`` and
+  ``state_dict`` fields so secrets/bulk tensors never leak into JSON
+  introspection responses; stringifies datetimes; tuplifies sets. KEPT.
+* ``RunningMean`` — exact weighted mean. The reference's EpochProgress
+  running mean is biased (utils.py:85-88: inputs [4,2,6] → 4.75, true
+  mean 4.0). FIXED.
+* ``PeriodicTask`` — asyncio start/stop sleep-loop wrapper (utils.py:42-67),
+  kept for heartbeats/culling, with the first call optionally immediate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+import string
+from contextlib import suppress
+from datetime import datetime
+from typing import Any
+
+_ALPHABET = string.ascii_letters + string.digits
+
+
+def random_key(length: int = 32) -> str:
+    """Cryptographically random URL-safe token of ``length`` chars."""
+    return "".join(secrets.choice(_ALPHABET) for _ in range(length))
+
+
+def json_clean(data: Any) -> Any:
+    """Recursively sanitize a structure for JSON responses.
+
+    Drops ``key``/``state_dict`` entries (credentials and bulk tensors),
+    stringifies datetimes, tuplifies sets — reference utils.py:23-35
+    semantics, extended to lists/tuples.
+    """
+    if isinstance(data, dict):
+        return {
+            k: json_clean(v)
+            for k, v in data.items()
+            if k not in ("key", "state_dict")
+        }
+    if isinstance(data, (list, tuple)):
+        return [json_clean(v) for v in data]
+    if isinstance(data, set):
+        return [json_clean(v) for v in sorted(data, key=str)]
+    if isinstance(data, datetime):
+        return str(data)
+    return data
+
+
+class RunningMean:
+    """Exact (optionally weighted) running mean."""
+
+    def __init__(self) -> None:
+        self.total = 0.0
+        self.weight = 0.0
+
+    def update(self, value: float, weight: float = 1.0) -> None:
+        self.total += float(value) * float(weight)
+        self.weight += float(weight)
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.weight if self.weight else 0.0
+
+
+class PeriodicTask:
+    """Run an async callable every ``interval`` seconds until stopped."""
+
+    def __init__(self, func, interval: float, run_immediately: bool = False):
+        self.func = func
+        self.interval = interval
+        self.run_immediately = run_immediately
+        self.is_started = False
+        self._task = None
+
+    def start(self) -> "PeriodicTask":
+        if not self.is_started:
+            self.is_started = True
+            self._task = asyncio.ensure_future(self._run())
+        return self
+
+    async def stop(self) -> None:
+        if self.is_started:
+            self.is_started = False
+            self._task.cancel()
+            with suppress(asyncio.CancelledError):
+                await self._task
+
+    async def _run(self) -> None:
+        if self.run_immediately and self.is_started:
+            await self.func()
+        while self.is_started:
+            await asyncio.sleep(self.interval)
+            await self.func()

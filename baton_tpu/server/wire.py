@@ -1,0 +1,141 @@
+"""Tensor wire format for the control plane.
+
+The reference ships pickled PyTorch state_dicts over HTTP
+(manager.py:85,98; worker.py:92,117) — unpickling network bytes on both
+sides. SURVEY §2.8 flags this for redesign. The native format here,
+``BTW1``, is safetensors-shaped: a JSON header describing dtype/shape/
+offset per tensor plus a raw little-endian payload — zero-copy decode,
+no code execution on parse.
+
+    b"BTW1" | uint32 header_len (LE) | header JSON | raw tensor bytes
+
+Header: ``{"meta": {...json-safe metadata...},
+"tensors": {name: {"dtype": str, "shape": [...], "offset": int}}}``.
+
+Pickle *decode* compatibility with reference workers is retained behind
+an explicit ``allow_pickle=True`` opt-in (demo parity only — the demo
+protocol is pickle, SURVEY §2.8).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import struct
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+MAGIC = b"BTW1"
+CONTENT_TYPE = "application/x-baton-tensors"
+PICKLE_CONTENT_TYPE = "application/x-pickle"
+
+_ALLOWED_DTYPES = {
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "uint8",
+    "uint16",
+    "uint32",
+    "uint64",
+    "bool",
+}
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def encode(tensors: Mapping[str, np.ndarray], meta: Dict[str, Any]) -> bytes:
+    """Serialize ``{name: array}`` + JSON-safe metadata to BTW1 bytes."""
+    header: Dict[str, Any] = {"meta": meta, "tensors": {}}
+    payload = io.BytesIO()
+    offset = 0
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        dtype_name = (
+            "bfloat16" if arr.dtype.name == "bfloat16" else arr.dtype.name
+        )
+        if dtype_name not in _ALLOWED_DTYPES:
+            raise ValueError(f"unsupported tensor dtype {arr.dtype} for {name!r}")
+        raw = arr.tobytes()
+        header["tensors"][name] = {
+            "dtype": dtype_name,
+            "shape": list(arr.shape),
+            "offset": offset,
+        }
+        payload.write(raw)
+        offset += len(raw)
+    hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return MAGIC + struct.pack("<I", len(hdr)) + hdr + payload.getvalue()
+
+
+def decode(data: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Parse BTW1 bytes → (tensors, meta). No code execution."""
+    if data[:4] != MAGIC:
+        raise ValueError("not a BTW1 payload")
+    (hdr_len,) = struct.unpack("<I", data[4:8])
+    header = json.loads(data[8 : 8 + hdr_len].decode("utf-8"))
+    body = memoryview(data)[8 + hdr_len :]
+    tensors: Dict[str, np.ndarray] = {}
+    names = list(header["tensors"].items())
+    for i, (name, info) in enumerate(names):
+        dtype = _np_dtype(info["dtype"])
+        shape = tuple(info["shape"])
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if shape else dtype.itemsize
+        start = info["offset"]
+        arr = np.frombuffer(body[start : start + nbytes], dtype=dtype).reshape(shape)
+        tensors[name] = arr
+    return tensors, header.get("meta", {})
+
+
+def decode_any(
+    body: bytes, content_type: str | None = None, allow_pickle: bool = False
+) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Decode a round_start/update body: BTW1 natively, pickle only when
+    explicitly allowed (reference-demo compatibility)."""
+    if body[:4] == MAGIC:
+        return decode(body)
+    if not allow_pickle:
+        raise ValueError(
+            "refusing non-BTW1 payload (enable allow_pickle for reference-"
+            "protocol compatibility)"
+        )
+    obj = pickle.loads(body)
+    meta = {k: v for k, v in obj.items() if k != "state_dict"}
+    tensors = {
+        k: _to_numpy(v) for k, v in obj.get("state_dict", {}).items()
+    }
+    return tensors, meta
+
+
+def encode_pickle(tensors: Mapping[str, np.ndarray], meta: Dict[str, Any]) -> bytes:
+    """Reference-protocol body: pickled {state_dict, **meta} with numpy
+    values (torch tensors on the reference side pickle-compatibly map to
+    arrays via __array__)."""
+    obj = dict(meta)
+    obj["state_dict"] = {k: np.asarray(v) for k, v in tensors.items()}
+    return pickle.dumps(obj)
+
+
+def _to_numpy(v) -> np.ndarray:
+    if isinstance(v, np.ndarray):
+        return v
+    # torch.Tensor and friends expose __array__ / .numpy()
+    numpy_fn = getattr(v, "numpy", None)
+    if callable(numpy_fn):
+        try:
+            return np.asarray(numpy_fn())
+        except (TypeError, RuntimeError):
+            pass
+    return np.asarray(v)

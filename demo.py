@@ -1,0 +1,61 @@
+"""End-to-end demo — CLI parity with the reference demo (demo.py:62-77).
+
+  python demo.py manager <host> <port>
+  python demo.py worker  <manager-host:port> <port>
+
+Same shape as the reference: the manager hosts the "lineartest"
+experiment (a 10→1 linear regressor); each worker invents
+``32·randint(5,20)`` samples of ``y = p·X`` for the fixed coefficient
+vector and trains locally with SGD lr=0.001, batch 32 (demo.py:29-59
+semantics — but the local loop is one jitted XLA program here).
+
+Drive it exactly like the reference:
+  curl 'http://<host>:<port>/lineartest/start_round?n_epoch=8'
+  curl 'http://<host>:<port>/lineartest/loss_history'
+"""
+
+import sys
+
+import numpy as np
+from aiohttp import web
+
+from baton_tpu.core.training import make_local_trainer
+from baton_tpu.data.synthetic import linear_client_data
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.server.http_manager import Manager
+from baton_tpu.server.http_worker import ExperimentWorker
+
+
+def main() -> None:
+    if len(sys.argv) != 4 or sys.argv[1] not in ("manager", "worker"):
+        print(__doc__)
+        raise SystemExit(1)
+    role, host, port = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+    model = linear_regression_model(10)  # name="lineartest"
+    app = web.Application()
+
+    if role == "manager":
+        manager = Manager(app)
+        manager.register_experiment(model, round_timeout=600.0)
+    else:
+        nprng = np.random.default_rng()
+
+        def get_data():
+            data = linear_client_data(nprng)
+            return data, data["x"].shape[0]
+
+        ExperimentWorker(
+            app,
+            model,
+            manager=host,  # reference quirk kept: worker's 2nd arg is the manager address
+            port=port,
+            trainer=make_local_trainer(model, batch_size=32, learning_rate=0.001),
+            get_data=get_data,
+        )
+
+    web.run_app(app, port=port)
+
+
+if __name__ == "__main__":
+    main()

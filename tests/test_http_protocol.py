@@ -1,0 +1,302 @@
+"""Wire-protocol integration tests (SURVEY §2.8 parity).
+
+Two layers: (a) manager endpoints exercised with an in-process aiohttp
+TestClient — routes, status codes 400/401/410/423; (b) a full two-app
+federation over real sockets: manager + N workers register, heartbeat,
+run rounds, and the aggregated global model converges — the in-test
+equivalent of the reference's manual two-process demo smoke test
+(SURVEY §4).
+"""
+
+import asyncio
+import socket
+
+import jax
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from baton_tpu.core.training import make_local_trainer
+from baton_tpu.data.synthetic import DEMO_COEF, linear_client_data
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.server import wire
+from baton_tpu.server.http_manager import Manager
+from baton_tpu.server.http_worker import ExperimentWorker
+from baton_tpu.server.state import params_to_state_dict
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ----------------------------------------------------------------------
+# (a) manager endpoint surface
+
+
+async def _manager_client():
+    app = web.Application()
+    manager = Manager(app)
+    exp = manager.register_experiment(
+        linear_regression_model(4), name="exp", start_background_tasks=False
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, exp
+
+
+def test_register_heartbeat_clients_routes():
+    async def main():
+        client, exp = await _manager_client()
+        resp = await client.get("/exp/register", json={"port": 9999})
+        assert resp.status == 200
+        creds = await resp.json()
+        assert creds["client_id"].startswith("client_exp_")
+
+        resp = await client.get(
+            "/exp/heartbeat",
+            json={"client_id": creds["client_id"], "key": creds["key"]},
+        )
+        assert resp.status == 200
+
+        resp = await client.get(
+            "/exp/heartbeat", json={"client_id": creds["client_id"], "key": "bad"}
+        )
+        assert resp.status == 401
+
+        resp = await client.get("/exp/clients")
+        listed = await resp.json()
+        assert len(listed) == 1 and "key" not in listed[0]
+        await client.close()
+
+    run(main())
+
+
+def test_start_round_validation_and_no_clients():
+    async def main():
+        client, exp = await _manager_client()
+        resp = await client.get("/exp/start_round?n_epoch=bogus")
+        assert resp.status == 400
+
+        # zero registered clients: round aborts cleanly (fix §2.9 item 3)
+        resp = await client.get("/exp/start_round?n_epoch=1")
+        assert resp.status == 200
+        assert await resp.json() == {}
+        # and a second round is NOT blocked by a leaked lock
+        resp = await client.get("/exp/start_round?n_epoch=1")
+        assert resp.status == 200
+        await client.close()
+
+    run(main())
+
+
+def test_update_auth_and_stale_round():
+    async def main():
+        client, exp = await _manager_client()
+        resp = await client.post("/exp/update?client_id=ghost&key=k", data=b"x")
+        assert resp.status == 401
+
+        resp = await client.get("/exp/register", json={"port": 1})
+        creds = await resp.json()
+        qs = f"?client_id={creds['client_id']}&key={creds['key']}"
+
+        # authenticated but no round in progress -> 410 Wrong Update
+        body = wire.encode(
+            params_to_state_dict(exp.params),
+            {"update_name": "update_exp_99999", "n_samples": 1, "loss_history": []},
+        )
+        resp = await client.post("/exp/update" + qs, data=body)
+        assert resp.status == 410
+
+        # garbage payload -> 400
+        exp.rounds.start_round(n_epoch=1)
+        exp.rounds.client_start(creds["client_id"])
+        resp = await client.post("/exp/update" + qs, data=b"not-a-payload")
+        assert resp.status == 400
+
+        # correct round: accepted, aggregation runs when last client reports
+        before = np.asarray(exp.params["w"]).copy()
+        new_sd = {
+            k: v + 1.0 for k, v in params_to_state_dict(exp.params).items()
+        }
+        body = wire.encode(
+            new_sd,
+            {
+                "update_name": exp.rounds.round_name,
+                "n_samples": 10,
+                "loss_history": [0.5],
+            },
+        )
+        resp = await client.post("/exp/update" + qs, data=body)
+        assert resp.status == 200
+        np.testing.assert_allclose(np.asarray(exp.params["w"]), before + 1.0, rtol=1e-6)
+        assert not exp.rounds.in_progress
+        assert exp.rounds.loss_history == [0.5]
+
+        resp = await client.get("/exp/loss_history")
+        assert await resp.json() == [0.5]
+        await client.close()
+
+    run(main())
+
+
+def test_malformed_state_dict_rejected_at_upload():
+    """Regression: a wrong-shaped or incomplete tensor set must 400 at
+    the door, not crash aggregation after the round state is consumed."""
+
+    async def main():
+        client, exp = await _manager_client()
+        resp = await client.get("/exp/register", json={"port": 1})
+        creds = await resp.json()
+        qs = f"?client_id={creds['client_id']}&key={creds['key']}"
+        exp.rounds.start_round(n_epoch=1)
+        exp.rounds.client_start(creds["client_id"])
+
+        # missing tensors
+        body = wire.encode(
+            {"w": np.ones((2, 1), np.float32)},
+            {"update_name": exp.rounds.round_name, "n_samples": 5, "loss_history": [1.0]},
+        )
+        resp = await client.post("/exp/update" + qs, data=body)
+        assert resp.status == 400
+
+        # wrong shape
+        sd = params_to_state_dict(exp.params)
+        sd["w"] = np.ones((9, 9), np.float32)
+        body = wire.encode(
+            sd,
+            {"update_name": exp.rounds.round_name, "n_samples": 5, "loss_history": [1.0]},
+        )
+        resp = await client.post("/exp/update" + qs, data=body)
+        assert resp.status == 400
+        assert exp.rounds.in_progress  # round intact, honest clients unaffected
+        await client.close()
+
+    run(main())
+
+
+def test_all_participants_culled_releases_round():
+    """Regression: if every participant dies mid-round, the round must
+    abort rather than 423 forever."""
+
+    async def main():
+        client, exp = await _manager_client()
+        resp = await client.get("/exp/register", json={"port": 1})
+        creds = await resp.json()
+        exp.rounds.start_round(n_epoch=1)
+        exp.rounds.client_start(creds["client_id"])
+
+        # client dies: culled from registry and dropped from the round
+        exp.registry.drop(creds["client_id"])
+        exp.rounds.drop_client(creds["client_id"])
+        exp._maybe_finish()
+        assert not exp.rounds.in_progress
+
+        resp = await client.get("/exp/start_round?n_epoch=1")
+        assert resp.status == 200  # not 423
+        await client.close()
+
+    run(main())
+
+
+def test_round_in_progress_423():
+    async def main():
+        client, exp = await _manager_client()
+        resp = await client.get("/exp/register", json={"port": 1})
+        creds = await resp.json()
+        exp.rounds.start_round(n_epoch=1)
+        exp.rounds.client_start(creds["client_id"])
+        resp = await client.get("/exp/start_round?n_epoch=1")
+        assert resp.status == 423
+        await client.close()
+
+    run(main())
+
+
+# ----------------------------------------------------------------------
+# (b) full federation over real sockets
+
+
+def test_end_to_end_federation_two_workers():
+    async def main():
+        model = linear_regression_model(10)
+        nprng = np.random.default_rng(0)
+
+        mport, w1port, w2port = free_port(), free_port(), free_port()
+
+        mapp = web.Application()
+        manager = Manager(mapp)
+        exp = manager.register_experiment(
+            model, name="lineartest", round_timeout=60.0
+        )
+        mrunner = web.AppRunner(mapp)
+        await mrunner.setup()
+        await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+        workers = []
+        runners = [mrunner]
+        for wport in (w1port, w2port):
+            data = linear_client_data(nprng, min_batches=2, max_batches=3)
+
+            wapp = web.Application()
+            worker = ExperimentWorker(
+                wapp,
+                model,
+                f"127.0.0.1:{mport}",
+                port=wport,
+                heartbeat_time=1.0,
+                trainer=make_local_trainer(model, batch_size=32, learning_rate=0.02),
+                get_data=lambda d=data: (d, d["x"].shape[0]),
+            )
+            wrunner = web.AppRunner(wapp)
+            await wrunner.setup()
+            await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+            workers.append(worker)
+            runners.append(wrunner)
+
+        # wait for both workers to register
+        for _ in range(100):
+            if len(exp.registry) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(exp.registry) == 2
+
+        # drive rounds through the public HTTP surface
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            for _ in range(5):
+                async with session.get(
+                    f"http://127.0.0.1:{mport}/lineartest/start_round?n_epoch=4"
+                ) as resp:
+                    assert resp.status == 200
+                    acks = await resp.json()
+                    assert all(acks.values())
+                for _ in range(200):
+                    if not exp.rounds.in_progress:
+                        break
+                    await asyncio.sleep(0.05)
+                assert not exp.rounds.in_progress
+
+            async with session.get(
+                f"http://127.0.0.1:{mport}/lineartest/loss_history"
+            ) as resp:
+                history = await resp.json()
+
+        assert len(history) == 20  # 5 rounds x 4 epochs
+        assert history[-1] < history[0]
+        np.testing.assert_allclose(
+            np.asarray(exp.params["w"]).ravel(), DEMO_COEF, atol=2.0
+        )
+        assert all(w.n_updates == 5 for w in workers)
+
+        for r in runners:
+            await r.cleanup()
+
+    run(main())

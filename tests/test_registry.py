@@ -1,0 +1,88 @@
+"""Membership registry: register/auth/heartbeat/cull with a fake clock
+(reference client_manager.py:86-150 semantics)."""
+
+import pytest
+
+from baton_tpu.server.registry import (
+    AuthError,
+    ClientRegistry,
+    UnknownClient,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def reg():
+    clock = FakeClock()
+    return ClientRegistry("exp", client_ttl=300.0, clock=clock), clock
+
+
+def test_register_issues_id_and_key(reg):
+    registry, _ = reg
+    c = registry.register(remote="1.2.3.4", port=9000)
+    assert c.client_id.startswith("client_exp_")
+    assert len(c.key) == 32
+    assert c.url == "http://1.2.3.4:9000/exp/"
+    assert len(registry) == 1
+    assert registry[c.client_id] is c
+
+
+def test_register_respects_explicit_url(reg):
+    registry, _ = reg
+    c = registry.register(remote="1.2.3.4", port=9000, url="http://cb:1/exp/")
+    assert c.url == "http://cb:1/exp/"
+
+
+def test_keys_are_unique_and_random(reg):
+    registry, _ = reg
+    keys = {registry.register(remote="r", port=1).key for _ in range(50)}
+    assert len(keys) == 50
+
+
+def test_heartbeat_updates_timestamp_and_auth(reg):
+    registry, clock = reg
+    c = registry.register(remote="r", port=1)
+    clock.t = 100.0
+    registry.heartbeat(c.client_id, c.key)
+    assert c.last_heartbeat == 100.0
+    with pytest.raises(AuthError):
+        registry.heartbeat(c.client_id, "wrong-key")
+    with pytest.raises(UnknownClient):
+        registry.heartbeat("client_exp_nobody", "k")
+
+
+def test_cull_evicts_stale_clients(reg):
+    registry, clock = reg
+    a = registry.register(remote="r", port=1)
+    b = registry.register(remote="r", port=2)
+    clock.t = 200.0
+    registry.heartbeat(b.client_id, b.key)
+    clock.t = 350.0  # a's heartbeat is 350s old, b's is 150s
+    evicted = registry.cull()
+    assert evicted == [a.client_id]
+    assert a.client_id not in registry
+    assert b.client_id in registry
+
+
+def test_to_json_strips_keys(reg):
+    registry, _ = reg
+    registry.register(remote="r", port=1)
+    js = registry.to_json()
+    assert len(js) == 1
+    assert "key" not in js[0]
+    assert "client_id" in js[0]
+
+
+def test_record_update(reg):
+    registry, _ = reg
+    c = registry.register(remote="r", port=1)
+    registry.record_update(c.client_id, "update_exp_00000")
+    assert c.last_update == "update_exp_00000"
+    assert c.num_updates == 1

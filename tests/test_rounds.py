@@ -1,0 +1,107 @@
+"""Round state machine semantics (reference update_manager.py:17-68 plus
+the SURVEY §2.9 fixes: abort, drop_client, timeout)."""
+
+import pytest
+
+from baton_tpu.server.rounds import (
+    RoundInProgress,
+    RoundManager,
+    RoundNotInProgress,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_round_naming_matches_reference_format():
+    rm = RoundManager("exp")
+    name = rm.start_round(n_epoch=4)
+    assert name == "update_exp_00000"
+    rm.client_start("a")
+    rm.client_end("a", {"ok": 1})
+    rm.end_round()
+    assert rm.start_round(n_epoch=1) == "update_exp_00001"
+
+
+def test_double_start_raises_in_progress():
+    rm = RoundManager("exp")
+    rm.start_round(n_epoch=1)
+    with pytest.raises(RoundInProgress):
+        rm.start_round(n_epoch=1)
+
+
+def test_client_tracking_and_clients_left():
+    rm = RoundManager("exp")
+    rm.start_round(n_epoch=1)
+    rm.client_start("a")
+    rm.client_start("b")
+    assert len(rm) == 2
+    assert rm.clients_left == 2
+    rm.client_end("a", 1)
+    assert rm.clients_left == 1
+    responses = None
+    rm.client_end("b", 2)
+    assert rm.clients_left == 0
+    responses = rm.end_round()
+    assert responses == {"a": 1, "b": 2}
+    assert len(rm) == 0  # reference __len__ semantics outside a round
+
+
+def test_client_ops_outside_round_raise():
+    rm = RoundManager("exp")
+    with pytest.raises(RoundNotInProgress):
+        rm.client_start("a")
+    with pytest.raises(RoundNotInProgress):
+        rm.client_end("a", 1)
+    with pytest.raises(RoundNotInProgress):
+        rm.end_round()
+
+
+def test_abort_releases_round_without_counting():
+    """Fix of §2.9 item 3: the reference leaked the round lock when zero
+    clients were registered; abort must fully release."""
+    rm = RoundManager("exp")
+    rm.start_round(n_epoch=1)
+    rm.abort_round()
+    assert not rm.in_progress
+    assert rm.n_rounds == 0
+    rm.start_round(n_epoch=1)  # must not raise 423-equivalent
+
+
+def test_drop_client_lets_round_finish():
+    """Fix of §2.9 item 4: a culled client must not hang the round."""
+    rm = RoundManager("exp")
+    rm.start_round(n_epoch=1)
+    rm.client_start("a")
+    rm.client_start("dead")
+    rm.client_end("a", 1)
+    assert rm.clients_left == 1
+    rm.drop_client("dead")
+    assert rm.clients_left == 0
+    assert rm.end_round() == {"a": 1}
+
+
+def test_round_timeout_expiry():
+    clock = FakeClock()
+    rm = RoundManager("exp", round_timeout=10.0, clock=clock)
+    rm.start_round(n_epoch=1)
+    rm.client_start("slow")
+    assert not rm.is_expired
+    clock.t = 11.0
+    assert rm.is_expired
+    # partial end: straggler never reported
+    assert rm.end_round() == {}
+    assert not rm.is_expired  # no round running
+
+
+def test_no_timeout_never_expires():
+    clock = FakeClock()
+    rm = RoundManager("exp", clock=clock)
+    rm.start_round(n_epoch=1)
+    clock.t = 1e9
+    assert not rm.is_expired

@@ -1,0 +1,112 @@
+"""BTW1 wire format: roundtrip, pickle gating, malformed payloads, and
+params<->state_dict bridging."""
+
+import numpy as np
+import pytest
+
+from baton_tpu.server import wire
+from baton_tpu.server.state import params_to_state_dict, state_dict_to_params
+from baton_tpu.server.utils import json_clean, random_key, RunningMean
+
+
+def test_roundtrip_preserves_tensors_and_meta(nprng):
+    tensors = {
+        "a/w": nprng.standard_normal((4, 3)).astype(np.float32),
+        "a/b": nprng.standard_normal(3).astype(np.float32),
+        "count": np.asarray(7, np.int64),
+    }
+    meta = {"update_name": "update_x_00001", "n_epoch": 4, "loss_history": [1.0, 0.5]}
+    blob = wire.encode(tensors, meta)
+    got_t, got_m = wire.decode(blob)
+    assert got_m == meta
+    for k in tensors:
+        np.testing.assert_array_equal(got_t[k], tensors[k])
+        assert got_t[k].dtype == tensors[k].dtype
+
+
+def test_bfloat16_roundtrip():
+    import ml_dtypes
+
+    arr = np.asarray([1.5, -2.25, 3.0], dtype=ml_dtypes.bfloat16)
+    blob = wire.encode({"x": arr}, {})
+    got, _ = wire.decode(blob)
+    assert got["x"].dtype == arr.dtype
+    np.testing.assert_array_equal(got["x"], arr)
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(ValueError, match="BTW1"):
+        wire.decode(b"NOPExxxxxxxx")
+
+
+def test_decode_any_refuses_pickle_by_default():
+    import pickle
+
+    blob = pickle.dumps({"state_dict": {"w": np.ones(3)}, "n_samples": 3})
+    with pytest.raises(ValueError, match="allow_pickle"):
+        wire.decode_any(blob)
+
+
+def test_decode_any_accepts_pickle_when_allowed():
+    import pickle
+
+    blob = pickle.dumps(
+        {"state_dict": {"w": np.ones(3, np.float32)}, "n_samples": 3}
+    )
+    tensors, meta = wire.decode_any(blob, allow_pickle=True)
+    np.testing.assert_array_equal(tensors["w"], np.ones(3))
+    assert meta["n_samples"] == 3
+
+
+def test_decode_any_handles_torch_tensors_when_allowed():
+    torch = pytest.importorskip("torch")
+    import pickle
+
+    blob = pickle.dumps(
+        {"state_dict": {"w": torch.ones(2, 2)}, "update_name": "u"}
+    )
+    tensors, meta = wire.decode_any(blob, allow_pickle=True)
+    np.testing.assert_array_equal(tensors["w"], np.ones((2, 2)))
+
+
+def test_state_dict_bridging_roundtrip():
+    params = {
+        "conv1": {"w": np.ones((3, 3), np.float32), "b": np.zeros(3, np.float32)},
+        "heads": [np.ones(2, np.float32), np.ones(4, np.float32)],
+    }
+    sd = params_to_state_dict(params)
+    assert set(sd) == {"conv1/w", "conv1/b", "heads/0", "heads/1"}
+    rebuilt = state_dict_to_params(params, sd)
+    np.testing.assert_array_equal(rebuilt["conv1"]["w"], params["conv1"]["w"])
+
+
+def test_state_dict_missing_and_mismatched_tensors():
+    params = {"w": np.ones((2, 2), np.float32)}
+    with pytest.raises(KeyError, match="missing"):
+        state_dict_to_params(params, {})
+    with pytest.raises(ValueError, match="shape"):
+        state_dict_to_params(params, {"w": np.ones((3, 3), np.float32)})
+
+
+def test_json_clean_strips_secrets():
+    data = {
+        "client_id": "c1",
+        "key": "SECRET",
+        "nested": {"state_dict": {"w": [1]}, "ok": {1, 2}},
+    }
+    cleaned = json_clean(data)
+    assert "key" not in cleaned
+    assert "state_dict" not in cleaned["nested"]
+    assert cleaned["nested"]["ok"] == [1, 2]
+
+
+def test_random_key_lengths():
+    assert len(random_key(64)) == 64  # reference capped at 52 chars
+    assert random_key(16) != random_key(16)
+
+
+def test_running_mean_is_exact():
+    rm = RunningMean()
+    for v in [4.0, 2.0, 6.0]:
+        rm.update(v)
+    assert rm.mean == pytest.approx(4.0)  # reference's biased mean gave 4.75
