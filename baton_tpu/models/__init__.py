@@ -1,9 +1,12 @@
 from baton_tpu.models.linear import linear_regression_model
 from baton_tpu.models.mlp import mlp_classifier_model
 from baton_tpu.models.cnn import cnn_mnist_model
+from baton_tpu.models.resnet import resnet_model, resnet18_cifar_model
 
 __all__ = [
     "linear_regression_model",
     "mlp_classifier_model",
     "cnn_mnist_model",
+    "resnet_model",
+    "resnet18_cifar_model",
 ]

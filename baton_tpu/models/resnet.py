@@ -1,0 +1,163 @@
+"""ResNet-18 with GroupNorm — the flagship model (BASELINE config 2).
+
+The reference framework never ships a real vision model (its demo model is
+a 10->1 linear layer, reference demo.py:15-49); ResNet-18/CIFAR-10 is the
+driver-set north-star workload. Design choices for TPU + federation:
+
+* **GroupNorm, not BatchNorm**: BN running stats don't aggregate under
+  client drift (see :meth:`baton_tpu.core.model.FedModel.from_flax`), and
+  GN keeps the model a pure function of (params, batch) — vmappable over
+  thousands of simulated clients with no mutable collections.
+* **NHWC + optional bfloat16 compute**: convs lower to MXU-tiled
+  ``conv_general_dilated``; params stay fp32 (FedAvg accumulates in
+  fp32), activations/weights are cast to ``compute_dtype`` per-apply.
+* **CIFAR stem** (3x3, stride 1, no maxpool) by default; ``imagenet_stem``
+  switches to 7x7/stride-2 + maxpool for 224px inputs (ViT-sized runs).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from baton_tpu.core.losses import softmax_cross_entropy
+from baton_tpu.core.model import FedModel
+
+STAGE_WIDTHS: Tuple[int, ...] = (64, 128, 256, 512)
+BLOCKS_PER_STAGE_18: Tuple[int, ...] = (2, 2, 2, 2)
+BLOCKS_PER_STAGE_34: Tuple[int, ...] = (3, 4, 6, 3)
+
+
+def _he(key, shape, fan_in):
+    return jax.random.normal(key, shape, jnp.float32) * jnp.sqrt(2.0 / fan_in)
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    return _he(key, (kh, kw, cin, cout), kh * kw * cin)
+
+
+def _gn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _group_norm(x, p, n_groups=32, eps=1e-5):
+    """GroupNorm over NHWC; stats in fp32 regardless of compute dtype."""
+    b, h, w, c = x.shape
+    g = min(n_groups, c)
+    xf = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = jnp.mean(xf, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(xf, axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    xf = xf.reshape(b, h, w, c)
+    return (xf * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _block_init(key, cin, cout, stride):
+    k1, k2, k3 = jax.random.split(key, 3)
+    p = {
+        "conv1": _conv_init(k1, 3, 3, cin, cout),
+        "gn1": _gn_init(cout),
+        "conv2": _conv_init(k2, 3, 3, cout, cout),
+        "gn2": _gn_init(cout),
+    }
+    if stride != 1 or cin != cout:
+        p["proj"] = _conv_init(k3, 1, 1, cin, cout)
+        p["gn_proj"] = _gn_init(cout)
+    return p
+
+
+def _block_apply(x, p, stride, n_groups):
+    out = _conv(x, p["conv1"], stride)
+    out = jax.nn.relu(_group_norm(out, p["gn1"], n_groups))
+    out = _conv(out, p["conv2"], 1)
+    out = _group_norm(out, p["gn2"], n_groups)
+    if "proj" in p:
+        x = _group_norm(_conv(x, p["proj"], stride), p["gn_proj"], n_groups)
+    return jax.nn.relu(out + x)
+
+
+def resnet_model(
+    blocks_per_stage: Sequence[int] = BLOCKS_PER_STAGE_18,
+    n_classes: int = 10,
+    channels: int = 3,
+    n_groups: int = 32,
+    width_multiplier: int = 1,
+    imagenet_stem: bool = False,
+    compute_dtype=jnp.float32,
+    name: str = "resnet18",
+) -> FedModel:
+    if len(blocks_per_stage) > len(STAGE_WIDTHS):
+        raise ValueError(
+            f"at most {len(STAGE_WIDTHS)} stages supported, got "
+            f"{len(blocks_per_stage)}"
+        )
+    widths = [w * width_multiplier for w in STAGE_WIDTHS]
+
+    def stride_of(s, b):
+        return 2 if (b == 0 and s > 0) else 1
+
+    def init(rng):
+        keys = jax.random.split(rng, 2 + sum(blocks_per_stage))
+        it = iter(keys)
+        stem_kh = 7 if imagenet_stem else 3
+        params = {
+            "stem": _conv_init(next(it), stem_kh, stem_kh, channels, widths[0]),
+            "gn_stem": _gn_init(widths[0]),
+        }
+        cin = widths[0]
+        for s, (n_blocks, cout) in enumerate(zip(blocks_per_stage, widths)):
+            for b in range(n_blocks):
+                params[f"s{s}b{b}"] = _block_init(
+                    next(it), cin, cout, stride_of(s, b)
+                )
+                cin = cout
+        params["fc"] = {
+            "w": _he(next(it), (cin, n_classes), cin),
+            "b": jnp.zeros((n_classes,), jnp.float32),
+        }
+        return params
+
+    def apply(params, batch, rng):
+        x = batch["x"].astype(compute_dtype)
+        stem_stride = 2 if imagenet_stem else 1
+        x = _conv(x, params["stem"], stem_stride)
+        x = jax.nn.relu(_group_norm(x, params["gn_stem"], n_groups))
+        if imagenet_stem:
+            x = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+            )
+        for s, n_blocks in enumerate(blocks_per_stage):
+            for b in range(n_blocks):
+                x = _block_apply(x, params[f"s{s}b{b}"], stride_of(s, b), n_groups)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = x.astype(jnp.float32) @ params["fc"]["w"] + params["fc"]["b"]
+        return logits
+
+    def per_example_loss(params, batch, rng):
+        return softmax_cross_entropy(apply(params, batch, rng), batch, rng)
+
+    return FedModel(init=init, apply=apply, per_example_loss=per_example_loss, name=name)
+
+
+def resnet18_cifar_model(
+    n_classes: int = 10, compute_dtype=jnp.float32, name: str = "resnet18_cifar"
+) -> FedModel:
+    """ResNet-18 for 32x32 inputs — the north-star/bench model."""
+    return resnet_model(
+        BLOCKS_PER_STAGE_18,
+        n_classes=n_classes,
+        compute_dtype=compute_dtype,
+        name=name,
+    )

@@ -1,0 +1,87 @@
+"""Benchmark: FedAvg rounds/sec, ResNet-18/CIFAR-10 simulated clients.
+
+North star (BASELINE.json): 1024 clients on a v4-32 at >=10 rounds/sec.
+This bench runs ONE chip's shard of that workload — 1024/32 = 32 simulated
+clients, ~48 CIFAR samples each (50k/1024), 1 local epoch, bf16 compute —
+and reports rounds/sec. ``vs_baseline`` is value / 10 (the target
+rounds/sec; the reference publishes no numbers of its own, BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+N_CLIENTS = 32          # one v4-32 chip's shard of 1024 clients
+SAMPLES_PER_CLIENT = 48  # ~50_000 / 1024
+BATCH_SIZE = 32
+N_EPOCHS = 1
+TIMED_ROUNDS = 5
+TARGET_ROUNDS_PER_SEC = 10.0
+
+
+def main() -> None:
+    from baton_tpu.models.resnet import resnet18_cifar_model
+    from baton_tpu.ops.padding import stack_client_datasets
+    from baton_tpu.parallel.engine import FedSim
+
+    rng = np.random.default_rng(0)
+    datasets = []
+    for _ in range(N_CLIENTS):
+        datasets.append({
+            "x": rng.normal(size=(SAMPLES_PER_CLIENT, 32, 32, 3)).astype(np.float32),
+            "y": rng.integers(0, 10, size=(SAMPLES_PER_CLIENT,)).astype(np.int32),
+        })
+    data, n_samples = stack_client_datasets(datasets, batch_size=BATCH_SIZE)
+    data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    model = resnet18_cifar_model(compute_dtype=jnp.bfloat16)
+    params = model.init(jax.random.key(0))
+    sim = FedSim(model, batch_size=BATCH_SIZE, learning_rate=0.05)
+
+    key = jax.random.key(1)
+
+    def one_round(p, k):
+        res = sim.run_round(p, data, n_samples, k, n_epochs=N_EPOCHS,
+                            collect_client_losses=False)
+        return res.params, res.loss_history
+
+    # warmup (compile); the float() host fetch is the sync point —
+    # block_until_ready does not synchronize on the tunneled TPU platform
+    key, sub = jax.random.split(key)
+    params, warm_loss = one_round(params, sub)
+    float(warm_loss[-1])
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_ROUNDS):
+        key, sub = jax.random.split(key)
+        params, loss = one_round(params, sub)
+    final_loss = float(loss[-1])  # host fetch: forces the whole chain
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = TIMED_ROUNDS / dt
+    print(
+        f"[bench] {N_CLIENTS} clients x {SAMPLES_PER_CLIENT} samples, "
+        f"ResNet-18/CIFAR-10 bf16, {TIMED_ROUNDS} rounds in {dt:.2f}s on "
+        f"{jax.devices()[0].platform}; final loss {final_loss:.3f}",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "fedavg_rounds_per_sec_resnet18_cifar10_32clients_1chip",
+        "value": round(rounds_per_sec, 3),
+        "unit": "rounds/sec",
+        "vs_baseline": round(rounds_per_sec / TARGET_ROUNDS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
