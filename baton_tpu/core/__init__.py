@@ -1,4 +1,13 @@
 from baton_tpu.core.model import FedModel
 from baton_tpu.core.training import LocalTrainer, make_local_trainer
+from baton_tpu.core.partition import ParamPartition, make_partition
+from baton_tpu.core.regularizers import fedprox
 
-__all__ = ["FedModel", "LocalTrainer", "make_local_trainer"]
+__all__ = [
+    "FedModel",
+    "LocalTrainer",
+    "make_local_trainer",
+    "ParamPartition",
+    "make_partition",
+    "fedprox",
+]

@@ -48,6 +48,9 @@ class FedModel:
     apply: Callable[[Params, Batch, PRNGKey], Any]
     per_example_loss: Callable[[Params, Batch, PRNGKey], jax.Array]
     name: str = "fedmodel"
+    # hashable model metadata (e.g. LoraSpec) — FedModel rides inside
+    # jit-static trainer fields, so anything here must hash/eq by value
+    aux: Any = None
 
     def masked_loss(self, params: Params, batch: Batch, rng: PRNGKey) -> jax.Array:
         """Mean loss over *real* (unmasked) examples.

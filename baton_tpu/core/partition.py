@@ -1,0 +1,87 @@
+"""Trainable/frozen parameter partitioning.
+
+The reference trains and ships every tensor in ``state_dict()`` every
+round (manager.py:77-86, 119-126). For fine-tuning workloads (LoRA,
+BASELINE configs 3-4) that is untenable on TPU: vmapping full Llama-class
+params over a client axis multiplies them by C. A :class:`ParamPartition`
+splits a param pytree into a *trainable* part (per-client, optimized,
+aggregated) and a *frozen* part (replicated once, shared by every
+simulated client) by a predicate over tree paths.
+
+Both halves are plain lists of leaves (lists are pytrees), so split and
+merge are jit-transparent and structure-exact by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax
+
+Params = Any
+PathPredicate = Callable[[str, Any], bool]
+
+
+def path_str(path) -> str:
+    """Render a jax key path as ``a/b/0``."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+class ParamPartition:
+    """Split/merge a fixed pytree structure by a per-leaf boolean mask.
+
+    Identity-hashed on purpose: instances ride inside jit-static trainer
+    fields, and two partitions are interchangeable only if they came from
+    the same construction site.
+    """
+
+    def __init__(self, treedef, mask: Tuple[bool, ...]):
+        self.treedef = treedef
+        self.mask = tuple(mask)
+        self.n_trainable = sum(self.mask)
+
+    def split(self, params: Params) -> Tuple[List, List]:
+        leaves = jax.tree_util.tree_leaves(params)
+        if len(leaves) != len(self.mask):
+            raise ValueError(
+                f"params have {len(leaves)} leaves, partition expects "
+                f"{len(self.mask)}"
+            )
+        trainable = [l for l, m in zip(leaves, self.mask) if m]
+        frozen = [l for l, m in zip(leaves, self.mask) if not m]
+        return trainable, frozen
+
+    def merge(self, trainable: List, frozen: List) -> Params:
+        if trainable is None or frozen is None:
+            raise ValueError(
+                "partition.merge needs both halves; a partition-configured "
+                "trainer must be passed the frozen leaves explicitly"
+            )
+        n_frozen = len(self.mask) - self.n_trainable
+        if len(trainable) != self.n_trainable or len(frozen) != n_frozen:
+            raise ValueError(
+                f"expected {self.n_trainable} trainable + {n_frozen} frozen "
+                f"leaves, got {len(trainable)} + {len(frozen)}"
+            )
+        t, f = iter(trainable), iter(frozen)
+        leaves = [next(t) if m else next(f) for m in self.mask]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+def make_partition(params: Params, predicate: PathPredicate) -> ParamPartition:
+    """Build a partition: ``predicate(path_str, leaf)`` True = trainable."""
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    mask = tuple(bool(predicate(path_str(p), l)) for p, l in path_leaves)
+    if not any(mask):
+        raise ValueError("partition selects no trainable leaves")
+    return ParamPartition(treedef, mask)

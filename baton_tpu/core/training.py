@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import optax
 
 from baton_tpu.core.model import Batch, FedModel, Params, PRNGKey
+from baton_tpu.core.partition import ParamPartition
 
 Regularizer = Callable[[Params, Params], jax.Array]
 
@@ -57,12 +58,19 @@ class LocalTrainer:
     pytree and the local objective becomes ``data_loss + regularizer(
     params, anchor)`` — the pluggable local-objective hook used for
     FedProx (anchor = the round's global params).
+
+    When ``partition`` is set, ``params`` is only the *trainable* leaf
+    list and the ``frozen`` leaf list must be supplied; the model sees
+    ``partition.merge(params, frozen)`` while gradients, optimizer state,
+    and the FedAvg payload stay trainable-only (LoRA fine-tuning: clients
+    carry adapters, never the base model).
     """
 
     model: FedModel
     optimizer: optax.GradientTransformation
     batch_size: int
     regularizer: Optional[Regularizer] = None
+    partition: Optional[ParamPartition] = None
 
     def init_opt_state(self, params: Params):
         return self.optimizer.init(params)
@@ -76,10 +84,11 @@ class LocalTrainer:
         rng: PRNGKey,
         n_epochs: int,
         anchor: Optional[Params] = None,
+        frozen: Optional[Params] = None,
     ):
         opt_state = self.optimizer.init(params)
         return self.train_with_opt_state(
-            params, opt_state, data, n_samples, rng, n_epochs, anchor
+            params, opt_state, data, n_samples, rng, n_epochs, anchor, frozen
         )
 
     @partial(jax.jit, static_argnums=(0, 6))
@@ -92,6 +101,7 @@ class LocalTrainer:
         rng: PRNGKey,
         n_epochs: int,
         anchor: Optional[Params] = None,
+        frozen: Optional[Params] = None,
     ):
         """Same as ``train`` but threads optimizer state (for stateful
         local optimizers persisted across rounds, or wave scheduling)."""
@@ -101,7 +111,8 @@ class LocalTrainer:
         n_samples = jnp.asarray(n_samples, jnp.int32)
 
         def objective(p, batch, step_rng):
-            data_loss_sum, count = self.model.loss_and_count(p, batch, step_rng)
+            full = self.partition.merge(p, frozen) if self.partition else p
+            data_loss_sum, count = self.model.loss_and_count(full, batch, step_rng)
             denom = jnp.maximum(count, 1.0)
             loss = data_loss_sum / denom
             if self.regularizer is not None:
@@ -161,6 +172,7 @@ def make_local_trainer(
     batch_size: int = 32,
     learning_rate: float = 1e-3,
     regularizer: Optional[Regularizer] = None,
+    partition: Optional[ParamPartition] = None,
 ) -> LocalTrainer:
     """Build a :class:`LocalTrainer`.
 
@@ -174,6 +186,7 @@ def make_local_trainer(
         optimizer=optimizer,
         batch_size=batch_size,
         regularizer=regularizer,
+        partition=partition,
     )
 
 

@@ -2,6 +2,7 @@ from baton_tpu.models.linear import linear_regression_model
 from baton_tpu.models.mlp import mlp_classifier_model
 from baton_tpu.models.cnn import cnn_mnist_model
 from baton_tpu.models.resnet import resnet_model, resnet18_cifar_model
+from baton_tpu.models.lora import lora_wrap, lora_trainable, merge_lora
 
 __all__ = [
     "linear_regression_model",
@@ -9,4 +10,7 @@ __all__ = [
     "cnn_mnist_model",
     "resnet_model",
     "resnet18_cifar_model",
+    "lora_wrap",
+    "lora_trainable",
+    "merge_lora",
 ]

@@ -1,0 +1,136 @@
+"""LoRA — low-rank adapter fine-tuning for federated models.
+
+BASELINE config 4 (Llama-class LoRA federated instruction-tune): clients
+train and ship only rank-r adapter factors; the base model is frozen and
+replicated once. In the reference's architecture this would still ship
+the full state_dict every round (manager.py:77-86); here the adapter-only
+payload composes with :class:`baton_tpu.core.partition.ParamPartition` so
+the per-client vmap axis carries just the adapters — the difference
+between C×8B and C×a-few-MB of HBM.
+
+Parameter-space formulation: for every targeted 2-D weight ``W [in,out]``
+the effective weight is ``W + (alpha/rank)·A@B`` with ``A [in,r]`` normal
+/ ``B [r,out]`` zeros (so step 0 is exactly the base model). The wrapped
+model's params are ``{"base": ..., "lora": {path: {"a","b"}}}`` and
+``apply`` merges on the fly — any model whose hot weights are 2-D matmul
+leaves gets LoRA without modifying its code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from baton_tpu.core.model import FedModel
+from baton_tpu.core.partition import path_str
+
+TargetPredicate = Callable[[str, Any], bool]
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraSpec:
+    """Rank/alpha of a wrapped model, stored on ``FedModel.aux`` so the
+    training-time scale and the deploy-time merge cannot diverge."""
+
+    rank: int
+    alpha: float
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def default_target(path: str, leaf) -> bool:
+    """Adapt every 2-D matrix leaf (matmul weights; biases/norms are 1-D)."""
+    return hasattr(leaf, "ndim") and leaf.ndim == 2
+
+
+def lora_trainable(path: str, leaf) -> bool:
+    """Partition predicate selecting adapter leaves of a wrapped model."""
+    return path.startswith("lora/")
+
+
+def _lora_paths(base_params, target: TargetPredicate):
+    path_leaves, _ = jax.tree_util.tree_flatten_with_path(base_params)
+    return [
+        (path_str(p), l.shape) for p, l in path_leaves if target(path_str(p), l)
+    ]
+
+
+def merge_lora_model(model: FedModel, params):
+    """Materialize deploy params for a :func:`lora_wrap`-ped model, using
+    the exact scale it was trained with (``model.aux``)."""
+    spec = model.aux
+    if not isinstance(spec, LoraSpec):
+        raise ValueError(f"{model.name} is not a lora_wrap-ped model")
+    return merge_lora(params, spec.alpha, spec.rank)
+
+
+def merge_lora(params, alpha: float, rank: int):
+    """Materialize effective base params: ``W += (alpha/rank)·A@B``.
+
+    Prefer :func:`merge_lora_model`, which cannot drift from the
+    training-time scale."""
+    scale = alpha / rank
+    lora = params["lora"]
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(params["base"])
+    merged = []
+    for p, leaf in path_leaves:
+        key = path_str(p)
+        if key in lora:
+            ab = lora[key]["a"] @ lora[key]["b"]
+            leaf = leaf + (scale * ab).astype(leaf.dtype)
+        merged.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, merged)
+
+
+def lora_wrap(
+    model: FedModel,
+    rank: int = 8,
+    alpha: Optional[float] = None,
+    target: TargetPredicate = default_target,
+    name: Optional[str] = None,
+) -> FedModel:
+    """Wrap ``model`` with LoRA adapters on every targeted 2-D weight.
+
+    Use with ``FedSim(..., trainable=lora_trainable)`` so only adapters
+    are per-client/aggregated. ``model.init`` supplies the base weights;
+    load pretrained weights by overwriting ``params["base"]`` after init.
+    """
+    if alpha is None:
+        alpha = 2.0 * rank
+    spec = LoraSpec(rank=rank, alpha=float(alpha))
+
+    def init(rng):
+        base_rng, lora_rng = jax.random.split(rng)
+        base = model.init(base_rng)
+        specs = _lora_paths(base, target)
+        if not specs:
+            raise ValueError("LoRA target predicate matched no 2-D leaves")
+        keys = jax.random.split(lora_rng, len(specs))
+        adapters = {}
+        for k, (path, shape) in zip(keys, specs):
+            fan_in, fan_out = shape
+            adapters[path] = {
+                "a": jax.random.normal(k, (fan_in, rank), jnp.float32)
+                / jnp.sqrt(fan_in),
+                "b": jnp.zeros((rank, fan_out), jnp.float32),
+            }
+        return {"base": base, "lora": adapters}
+
+    def apply(params, batch, rng):
+        return model.apply(merge_lora(params, alpha, rank), batch, rng)
+
+    def per_example_loss(params, batch, rng):
+        return model.per_example_loss(merge_lora(params, alpha, rank), batch, rng)
+
+    return FedModel(
+        init=init,
+        apply=apply,
+        per_example_loss=per_example_loss,
+        name=name or f"{model.name}_lora{rank}",
+        aux=spec,
+    )

@@ -39,6 +39,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from baton_tpu.core.model import FedModel
+from baton_tpu.core.partition import PathPredicate, make_partition
 from baton_tpu.core.training import LocalTrainer, make_local_trainer, make_evaluator
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.ops.padding import round_up
@@ -77,6 +78,7 @@ class FedSim:
         server_optimizer: Optional[optax.GradientTransformation] = None,
         mesh: Optional[Mesh] = None,
         regularizer=None,
+        trainable: Optional[PathPredicate] = None,
     ):
         self.model = model
         self.trainer: LocalTrainer = make_local_trainer(
@@ -89,6 +91,25 @@ class FedSim:
         self.server_optimizer = server_optimizer
         self.mesh = mesh
         self.evaluate = make_evaluator(model)
+        # ``trainable(path, leaf) -> bool`` restricts training/aggregation
+        # to a sub-pytree (LoRA adapters); frozen leaves are replicated
+        # once, never per-client. Partition built lazily from the first
+        # params seen (structure unknown until then).
+        self.trainable_predicate = trainable
+        self.partition = None
+
+    def _ensure_partition(self, params):
+        if self.trainable_predicate is None or self.partition is not None:
+            return
+        self.partition = make_partition(params, self.trainable_predicate)
+        self.trainer = dataclasses.replace(self.trainer, partition=self.partition)
+
+    def _split(self, params):
+        """(trainable, frozen) — identity when no partition is configured."""
+        if self.trainable_predicate is None:
+            return params, None
+        self._ensure_partition(params)
+        return self.partition.split(params)
 
     # ------------------------------------------------------------------
     def init(self, rng: jax.Array) -> Params:
@@ -97,16 +118,19 @@ class FedSim:
     def init_server_opt_state(self, params: Params):
         if self.server_optimizer is None:
             return None
-        return self.server_optimizer.init(params)
+        trainable, _ = self._split(params)
+        return self.server_optimizer.init(trainable)
 
     # ------------------------------------------------------------------
     # wave kernels: return (Σ w·params, Σ w·losses, Σ w, per-client losses)
-    @partial(jax.jit, static_argnums=(0, 5))
-    def _wave_sums_vmap(self, params, data, n_samples, rngs, n_epochs):
+    @partial(jax.jit, static_argnums=(0, 6))
+    def _wave_sums_vmap(self, params, frozen, data, n_samples, rngs, n_epochs):
         anchor = params if self.trainer.regularizer is not None else None
 
         def one_client(d, n, r):
-            p, _, losses = self.trainer.train(params, d, n, r, n_epochs, anchor)
+            p, _, losses = self.trainer.train(
+                params, d, n, r, n_epochs, anchor, frozen
+            )
             return p, losses
 
         client_params, client_losses = jax.vmap(one_client)(data, n_samples, rngs)
@@ -126,11 +150,13 @@ class FedSim:
         mesh = self.mesh
         trainer = self.trainer
 
-        def kernel(params, data, n_samples, rngs):
+        def kernel(params, frozen, data, n_samples, rngs):
             anchor = params if trainer.regularizer is not None else None
 
             def one_client(d, n, r):
-                p, _, losses = trainer.train(params, d, n, r, n_epochs, anchor)
+                p, _, losses = trainer.train(
+                    params, d, n, r, n_epochs, anchor, frozen
+                )
                 return p, losses
 
             client_params, client_losses = jax.vmap(one_client)(
@@ -149,7 +175,7 @@ class FedSim:
         sharded = jax.shard_map(
             kernel,
             mesh=mesh,
-            in_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
+            in_specs=(P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
             out_specs=(P(), P(), P(), P(CLIENT_AXIS)),
             check_vma=False,
         )
@@ -203,6 +229,7 @@ class FedSim:
         simulated analogue of only some registered clients acking a
         round, reference manager.py:87-92).
         """
+        params, frozen = self._split(params)
         n_samples = jnp.asarray(n_samples)
         if client_indices is not None:
             idx = jnp.asarray(client_indices)
@@ -219,10 +246,12 @@ class FedSim:
 
         if self.mesh is not None:
             wave_fn = self._make_wave_sums_sharded(n_epochs)
-            call = lambda d, n, r: wave_fn(params, d, n, r)
+            call = lambda d, n, r: wave_fn(params, frozen, d, n, r)
             in_shard = client_sharding(self.mesh)
         else:
-            call = lambda d, n, r: self._wave_sums_vmap(params, d, n, r, n_epochs)
+            call = lambda d, n, r: self._wave_sums_vmap(
+                params, frozen, d, n, r, n_epochs
+            )
             in_shard = None
 
         psum_acc = None
@@ -262,6 +291,9 @@ class FedSim:
             )
         else:
             new_params = aggregate
+
+        if self.partition is not None:
+            new_params = self.partition.merge(new_params, frozen)
 
         return RoundResult(
             params=new_params,

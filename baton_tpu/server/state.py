@@ -13,19 +13,9 @@ from typing import Any, Dict
 import jax
 import numpy as np
 
+from baton_tpu.core.partition import path_str as _path_str
+
 Params = Any
-
-
-def _path_str(path) -> str:
-    parts = []
-    for p in path:
-        if hasattr(p, "key"):
-            parts.append(str(p.key))
-        elif hasattr(p, "idx"):
-            parts.append(str(p.idx))
-        else:
-            parts.append(str(p))
-    return "/".join(parts)
 
 
 def params_to_state_dict(params: Params) -> Dict[str, np.ndarray]:

@@ -1,0 +1,139 @@
+"""LoRA adapter fine-tuning + FedProx regularization.
+
+Checks: partition split/merge round-trips; LoRA init is a no-op at step 0
+(B=0); federated LoRA rounds change ONLY adapter leaves (base frozen and
+byte-identical); LoRA training reduces loss; FedProx shrinks client drift
+relative to plain FedAvg.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from baton_tpu.core.partition import make_partition
+from baton_tpu.core.regularizers import fedprox
+from baton_tpu.models.lora import lora_wrap, lora_trainable, merge_lora_model
+from baton_tpu.models.mlp import mlp_classifier_model
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.data.synthetic import linear_client_data
+from baton_tpu.ops.padding import stack_client_datasets
+from baton_tpu.parallel.engine import FedSim
+from baton_tpu.parallel.mesh import make_mesh
+
+
+def test_partition_split_merge_roundtrip():
+    params = {"a": {"w": jnp.ones((2, 3)), "b": jnp.zeros((3,))},
+              "c": jnp.arange(4.0)}
+    part = make_partition(params, lambda path, leaf: leaf.ndim == 2)
+    trainable, frozen = part.split(params)
+    assert len(trainable) == 1 and len(frozen) == 2
+    merged = part.merge(trainable, frozen)
+    assert jax.tree_util.tree_structure(merged) == jax.tree_util.tree_structure(params)
+    for x, y in zip(jax.tree_util.tree_leaves(merged),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_partition_rejects_empty_selection():
+    with pytest.raises(ValueError):
+        make_partition({"a": jnp.ones(3)}, lambda p, l: False)
+
+
+def _classif_data(nprng, n_clients=4, dim=8, n_classes=4):
+    datasets = []
+    w = nprng.normal(size=(dim, n_classes))
+    for _ in range(n_clients):
+        n = int(nprng.integers(20, 40))
+        x = nprng.normal(size=(n, dim)).astype(np.float32)
+        y = np.argmax(x @ w + 0.1 * nprng.normal(size=(n, n_classes)), axis=1)
+        datasets.append({"x": x, "y": y.astype(np.int32)})
+    return stack_client_datasets(datasets, batch_size=16)
+
+
+def test_lora_init_is_identity(nprng):
+    base_model = mlp_classifier_model(8, (16,), 4)
+    model = lora_wrap(base_model, rank=4)
+    params = model.init(jax.random.key(0))
+    batch = {"x": jnp.asarray(nprng.normal(size=(5, 8)), jnp.float32),
+             "y": jnp.zeros((5,), jnp.int32)}
+    out_wrapped = model.apply(params, batch, jax.random.key(1))
+    out_base = base_model.apply(params["base"], batch, jax.random.key(1))
+    np.testing.assert_allclose(np.asarray(out_wrapped), np.asarray(out_base),
+                               rtol=1e-6)
+
+
+def test_federated_lora_trains_only_adapters(nprng):
+    base_model = mlp_classifier_model(8, (16,), 4)
+    model = lora_wrap(base_model, rank=4)
+    params = model.init(jax.random.key(0))
+    data, n_samples = _classif_data(nprng)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+
+    sim = FedSim(model, batch_size=16, learning_rate=0.1,
+                 trainable=lora_trainable)
+    p, hist = sim.run_rounds(params, data, jnp.asarray(n_samples),
+                             jax.random.key(2), n_rounds=4, n_epochs=2)
+    # base unchanged, bit for bit
+    for a, b in zip(jax.tree_util.tree_leaves(p["base"]),
+                    jax.tree_util.tree_leaves(params["base"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # at least one adapter leaf moved and loss decreased
+    moved = any(
+        float(jnp.max(jnp.abs(a - b))) > 0
+        for a, b in zip(jax.tree_util.tree_leaves(p["lora"]),
+                        jax.tree_util.tree_leaves(params["lora"]))
+    )
+    assert moved
+    assert hist[-1] < hist[0]
+    # merged deployment params differ from base
+    merged = merge_lora_model(model, p)
+    diffs = [float(jnp.max(jnp.abs(a - b)))
+             for a, b in zip(jax.tree_util.tree_leaves(merged),
+                             jax.tree_util.tree_leaves(params["base"]))]
+    assert max(diffs) > 0
+
+
+def test_federated_lora_on_mesh_matches_vmap(nprng):
+    base_model = mlp_classifier_model(8, (16,), 4)
+    model = lora_wrap(base_model, rank=2)
+    params = model.init(jax.random.key(0))
+    data, n_samples = _classif_data(nprng, n_clients=8)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    sim_v = FedSim(model, batch_size=16, learning_rate=0.1,
+                   trainable=lora_trainable)
+    sim_m = FedSim(model, batch_size=16, learning_rate=0.1,
+                   trainable=lora_trainable, mesh=make_mesh(8))
+    rv = sim_v.run_round(params, data, n_samples, jax.random.key(3), n_epochs=1)
+    rm = sim_m.run_round(params, data, n_samples, jax.random.key(3), n_epochs=1)
+    for a, b in zip(jax.tree_util.tree_leaves(rv.params),
+                    jax.tree_util.tree_leaves(rm.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_fedprox_reduces_client_drift(nprng):
+    model = linear_regression_model(10)
+    datasets = [linear_client_data(nprng, min_batches=2, max_batches=3)
+                for _ in range(4)]
+    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+    params = model.init(jax.random.key(0))
+
+    def drift(sim):
+        res = sim.run_round(params, data, n_samples, jax.random.key(5),
+                            n_epochs=8)
+        # mean client distance from the aggregate is not exposed; proxy:
+        # distance of the aggregate from the anchor
+        return float(jnp.sqrt(sum(
+            jnp.sum((a - b) ** 2) for a, b in
+            zip(jax.tree_util.tree_leaves(res.params),
+                jax.tree_util.tree_leaves(params)))))
+
+    plain = drift(FedSim(model, batch_size=32, learning_rate=0.05))
+    prox = drift(FedSim(model, batch_size=32, learning_rate=0.05,
+                        regularizer=fedprox(mu=1.0)))
+    assert prox < plain
