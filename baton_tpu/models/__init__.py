@@ -3,6 +3,9 @@ from baton_tpu.models.mlp import mlp_classifier_model
 from baton_tpu.models.cnn import cnn_mnist_model
 from baton_tpu.models.resnet import resnet_model, resnet18_cifar_model
 from baton_tpu.models.lora import lora_wrap, lora_trainable, merge_lora
+from baton_tpu.models.bert import BertConfig, bert_classifier_model
+from baton_tpu.models.llama import LlamaConfig, llama_lm_model, llama_lora_target
+from baton_tpu.models.vit import ViTConfig, vit_model
 
 __all__ = [
     "linear_regression_model",
@@ -13,4 +16,11 @@ __all__ = [
     "lora_wrap",
     "lora_trainable",
     "merge_lora",
+    "BertConfig",
+    "bert_classifier_model",
+    "LlamaConfig",
+    "llama_lm_model",
+    "llama_lora_target",
+    "ViTConfig",
+    "vit_model",
 ]

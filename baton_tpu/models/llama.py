@@ -1,0 +1,158 @@
+"""Llama-class decoder-only LM (BASELINE config 4: LoRA instruction-tune).
+
+The reference has no language models (reference demo.py:15-49 is its whole
+zoo); this decoder exists for the driver-set federated LoRA workload.
+Architecture is the modern decoder recipe — RMSNorm pre-norm, RoPE,
+SwiGLU MLP, grouped-query attention, untied output head — built from the
+TPU-first blocks in :mod:`baton_tpu.models.transformer`:
+
+* params fp32 / activations ``compute_dtype`` (bf16 on TPU), norms and
+  softmax in fp32;
+* causal masking is static inside the attention kernel; an optional
+  per-token ``loss_mask`` weights the LM loss (instruction tuning
+  masks the prompt);
+* ``attention_fn`` is injectable — dense, fused-blockwise, or ring
+  attention over a sequence mesh axis all fit behind the same signature;
+* for federation, pair with :func:`baton_tpu.models.lora.lora_wrap` and
+  ``trainable=lora_trainable`` so simulated clients carry only the
+  adapter pytree (see :func:`llama_lora_target` for the standard
+  attention-projection targeting).
+
+Batches: ``{"x": int32[B, L] inputs, "y": int32[B, L] next-token targets,
+"loss_mask"?: [B, L] 1.0 = token counts toward the loss}``. The
+per-example loss is the per-sequence mean over unmasked tokens — [B],
+as the framework contract requires (core/model.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from baton_tpu.core.model import FedModel
+from baton_tpu.models.transformer import (
+    AttentionFn,
+    dense_init,
+    dot_product_attention,
+    mha_apply,
+    mha_init,
+    normal_init,
+    per_token_cross_entropy,
+    rms_init,
+    rms_norm,
+    rope_angles,
+    swiglu_apply,
+    swiglu_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    max_len: int = 8192
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    rope_theta: float = 500000.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test-sized config (CI / CPU-mesh tests)."""
+        defaults = dict(
+            vocab_size=256, max_len=32, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=128, rope_theta=10000.0,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def llama_lora_target(path: str, leaf) -> bool:
+    """LoRA target predicate: the attention projections (wq/wk/wv/wo) —
+    the standard adapter placement for instruction tuning."""
+    return path.rsplit("/", 1)[-1] in ("wq", "wk", "wv", "wo")
+
+
+def _block_init(key, cfg: LlamaConfig):
+    ka, km = jax.random.split(key)
+    return {
+        "norm_attn": rms_init(cfg.d_model),
+        "attn": mha_init(
+            ka, cfg.d_model, cfg.n_heads, n_kv_heads=cfg.n_kv_heads,
+            out_std=cfg.d_model ** -0.5 / (2 * cfg.n_layers) ** 0.5,
+        ),
+        "norm_mlp": rms_init(cfg.d_model),
+        "mlp": swiglu_init(km, cfg.d_model, cfg.d_ff),
+    }
+
+
+def _block_apply(p, x, cfg: LlamaConfig, rope, attention_fn: AttentionFn):
+    x = x + mha_apply(
+        p["attn"], rms_norm(x, p["norm_attn"]), cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads, causal=True, rope=rope,
+        attention_fn=attention_fn,
+    )
+    return x + swiglu_apply(p["mlp"], rms_norm(x, p["norm_mlp"]))
+
+
+def llama_lm_model(
+    config: Optional[LlamaConfig] = None,
+    compute_dtype=jnp.float32,
+    attention_fn: AttentionFn = dot_product_attention,
+    name: str = "llama_lm",
+) -> FedModel:
+    cfg = config or LlamaConfig.llama3_8b()
+
+    def init(rng):
+        keys = jax.random.split(rng, cfg.n_layers + 2)
+        return {
+            "tok_emb": normal_init(keys[0], (cfg.vocab_size, cfg.d_model), 0.02),
+            "blocks": [
+                _block_init(keys[1 + i], cfg) for i in range(cfg.n_layers)
+            ],
+            "norm_f": rms_init(cfg.d_model),
+            "lm_head": dense_init(keys[-1], cfg.d_model, cfg.vocab_size),
+        }
+
+    def apply(params, batch, rng):
+        """Returns next-token logits [B, L, V] (fp32)."""
+        ids = batch["x"]
+        l = ids.shape[1]
+        rope = rope_angles(l, cfg.head_dim, cfg.rope_theta)
+        x = params["tok_emb"][ids].astype(compute_dtype)
+        for blk in params["blocks"]:
+            x = _block_apply(blk, x, cfg, rope, attention_fn)
+        x = rms_norm(x, params["norm_f"])
+        # bf16 operands, fp32 accumulation: the vocab projection is the
+        # model's largest matmul — keep it on the fast MXU path
+        return jax.lax.dot_general(
+            x, params["lm_head"].astype(x.dtype),
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    def per_example_loss(params, batch, rng):
+        logits = apply(params, batch, rng)
+        tok_loss = per_token_cross_entropy(logits, batch["y"])  # [B, L]
+        loss_mask = batch.get("loss_mask")
+        if loss_mask is None:
+            return jnp.mean(tok_loss, axis=-1)
+        m = loss_mask.astype(jnp.float32)
+        return jnp.sum(tok_loss * m, axis=-1) / jnp.maximum(
+            jnp.sum(m, axis=-1), 1.0
+        )
+
+    return FedModel(init=init, apply=apply, per_example_loss=per_example_loss,
+                    name=name, aux=cfg)

@@ -1,0 +1,247 @@
+"""Shared transformer building blocks for the baton_tpu model zoo.
+
+The reference ships no transformer (its only model is a 10->1 linear
+layer, reference demo.py:15-49); BASELINE configs 3-5 (BERT/AG-News
+FedProx, Llama-class LoRA instruction-tune, ViT-B/16 DP cross-silo) are
+driver-set workloads that need one. These blocks are written TPU-first:
+
+* **Everything is einsum/matmul** on [B, L, D]-shaped activations so XLA
+  tiles the projections and the attention contractions onto the MXU;
+  params stay fp32 (FedAvg accumulates fp32), activations are cast to a
+  ``compute_dtype`` (bf16 on TPU) per-apply, norms/softmax in fp32.
+* **Static shapes only** — causal masking is a static ``L x L`` bound
+  inside the kernel, padding is a dynamic length vector turned into an
+  additive bias; no data-dependent control flow, so the whole model jits
+  and vmaps over a simulated-client axis.
+* **Injectable attention kernel**: every model takes an ``attention_fn``
+  with the signature of :func:`dot_product_attention` so the dense
+  kernel can be swapped for a fused/blockwise kernel or ring attention
+  over a sequence mesh axis without touching model code.
+* **GQA layout** [B, H, L, Dh] with an explicit kv-head axis: K/V heads
+  are broadcast to query groups by reshape, not materialized repeats.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+# attention_fn(q, k, v, bias, causal) -> out
+#   q [B, Hq, L, Dh], k/v [B, Hkv, L, Dh], bias None or [B, 1, 1, L] additive
+AttentionFn = Callable[..., jax.Array]
+
+
+# ---------------------------------------------------------------------------
+# initializers
+
+
+def normal_init(key, shape, stddev):
+    return jax.random.normal(key, shape, jnp.float32) * stddev
+
+
+def dense_init(key, d_in, d_out, stddev=None):
+    """[d_in, d_out] fan-in scaled normal (stddev 1/sqrt(d_in) default)."""
+    if stddev is None:
+        stddev = d_in ** -0.5
+    return normal_init(key, (d_in, d_out), stddev)
+
+
+def ln_init(d):
+    return {"scale": jnp.ones((d,), jnp.float32), "bias": jnp.zeros((d,), jnp.float32)}
+
+
+def rms_init(d):
+    return {"scale": jnp.ones((d,), jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# norms (fp32 stats regardless of compute dtype)
+
+
+def layer_norm(x, p, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (xf * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def rms_norm(x, p, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(ms + eps) * p["scale"]).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# rotary position embeddings (RoPE)
+
+
+def rope_angles(seq_len: int, head_dim: int, theta: float = 10000.0):
+    """Returns (cos, sin) each [L, Dh/2], fp32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    ang = jnp.outer(pos, inv_freq)  # [L, Dh/2]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate pairs of channels. x [B, H, L, Dh]; cos/sin [L, Dh/2]."""
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    # broadcast [L, Dh/2] over [B, H, L, Dh/2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    return jnp.concatenate([r1, r2], axis=-1).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# attention
+
+
+def dot_product_attention(q, k, v, bias=None, causal=False):
+    """Dense scaled-dot-product attention with GQA.
+
+    q [B, Hq, L, Dh]; k, v [B, Hkv, L, Dh] with Hq % Hkv == 0. Softmax in
+    fp32; the two contractions are einsums XLA maps onto the MXU. ``bias``
+    is additive, broadcastable to [B, Hq, L, L] (padding uses -inf-like
+    large negatives).
+    """
+    b, hq, l, dh = q.shape
+    hkv = k.shape[1]
+    scale = dh ** -0.5
+    if hq != hkv:
+        q = q.reshape(b, hkv, hq // hkv, l, dh)
+        scores = jnp.einsum("bhgqd,bhkd->bhgqk", q, k) * scale
+        scores = scores.reshape(b, hq, l, l)
+    else:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    scores = scores.astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias
+    if causal:
+        ql = jnp.arange(l)
+        scores = jnp.where(ql[:, None] >= ql[None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    if hq != hkv:
+        probs = probs.reshape(b, hkv, hq // hkv, l, l)
+        out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v)
+        return out.reshape(b, hq, l, dh)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def padding_bias(mask, dtype=jnp.float32):
+    """[B, L] 1/0 validity mask -> additive [B, 1, 1, L] attention bias."""
+    return ((1.0 - mask.astype(jnp.float32)) * -1e30)[:, None, None, :].astype(dtype)
+
+
+def mha_init(key, d_model, n_heads, n_kv_heads=None, head_dim=None, out_std=None):
+    """Fused QKV-per-role projection params for (G)MQA attention."""
+    n_kv = n_kv_heads or n_heads
+    dh = head_dim or d_model // n_heads
+    kq, kk, kv, ko = jax.random.split(key, 4)
+    return {
+        "wq": dense_init(kq, d_model, n_heads * dh),
+        "wk": dense_init(kk, d_model, n_kv * dh),
+        "wv": dense_init(kv, d_model, n_kv * dh),
+        "wo": dense_init(ko, n_heads * dh, d_model, stddev=out_std),
+    }
+
+
+def mha_apply(
+    p,
+    x,
+    n_heads: int,
+    n_kv_heads: Optional[int] = None,
+    bias=None,
+    causal: bool = False,
+    rope: Optional[tuple] = None,
+    attention_fn: AttentionFn = dot_product_attention,
+):
+    """Multi-head attention over x [B, L, D] -> [B, L, D]."""
+    b, l, _ = x.shape
+    n_kv = n_kv_heads or n_heads
+    dh = p["wq"].shape[1] // n_heads
+
+    def proj(w, h):
+        y = x @ w.astype(x.dtype)
+        return y.reshape(b, l, h, dh).transpose(0, 2, 1, 3)  # [B, H, L, Dh]
+
+    q, k, v = proj(p["wq"], n_heads), proj(p["wk"], n_kv), proj(p["wv"], n_kv)
+    if rope is not None:
+        cos, sin = rope
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    out = attention_fn(q, k, v, bias=bias, causal=causal)
+    out = out.transpose(0, 2, 1, 3).reshape(b, l, n_heads * dh)
+    return out @ p["wo"].astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# MLPs
+
+
+def gelu_mlp_init(key, d_model, d_ff):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": dense_init(k1, d_model, d_ff),
+        "b1": jnp.zeros((d_ff,), jnp.float32),
+        "w2": dense_init(k2, d_ff, d_model),
+        "b2": jnp.zeros((d_model,), jnp.float32),
+    }
+
+
+def gelu_mlp_apply(p, x):
+    h = x @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype)
+    h = jax.nn.gelu(h)
+    return h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype)
+
+
+def swiglu_init(key, d_model, d_ff):
+    kg, ku, kd = jax.random.split(key, 3)
+    return {
+        "w_gate": dense_init(kg, d_model, d_ff),
+        "w_up": dense_init(ku, d_model, d_ff),
+        "w_down": dense_init(kd, d_ff, d_model),
+    }
+
+
+def swiglu_apply(p, x):
+    g = jax.nn.silu(x @ p["w_gate"].astype(x.dtype))
+    u = x @ p["w_up"].astype(x.dtype)
+    return (g * u) @ p["w_down"].astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pre-LN encoder block (shared by BERT and ViT)
+
+
+def prenorm_block_init(key, d_model, n_heads, d_ff):
+    ka, km = jax.random.split(key)
+    return {
+        "ln1": ln_init(d_model),
+        "attn": mha_init(ka, d_model, n_heads),
+        "ln2": ln_init(d_model),
+        "mlp": gelu_mlp_init(km, d_model, d_ff),
+    }
+
+
+def prenorm_block_apply(p, x, n_heads, bias=None,
+                        attention_fn: AttentionFn = dot_product_attention):
+    x = x + mha_apply(p["attn"], layer_norm(x, p["ln1"]), n_heads,
+                      bias=bias, attention_fn=attention_fn)
+    return x + gelu_mlp_apply(p["mlp"], layer_norm(x, p["ln2"]))
+
+
+# ---------------------------------------------------------------------------
+# per-example LM loss (used by llama.py; here because it is model-generic)
+
+
+def per_token_cross_entropy(logits, labels):
+    """logits [B, L, V], labels int32 [B, L] -> fp32 [B, L]."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, labels[..., None], axis=-1).squeeze(-1)
+    return logz - ll

@@ -1,0 +1,133 @@
+"""Vision Transformer (BASELINE config 5: ViT-B/16 DP cross-silo).
+
+The reference's only model is a linear regressor (reference
+demo.py:15-49); ViT exists for the driver-set differential-privacy
+cross-silo workload. TPU-first construction on the shared blocks of
+:mod:`baton_tpu.models.transformer`:
+
+* **Patchify is one matmul**: [B, H, W, C] -> [B, N, P*P*C] by reshape/
+  transpose, then a dense projection — identical math to the usual
+  stride-P conv, but explicitly the shape XLA tiles best on the MXU.
+* Pre-LN encoder blocks, GELU MLP, learned position embeddings, class
+  token, fp32 norms/softmax over ``compute_dtype`` activations.
+* No BatchNorm anywhere (pure function of params — vmappable over the
+  client axis; cf. core/model.py on the federated BN problem).
+
+Batches: ``{"x": f32[B, H, W, C], "y": int32[B]}`` with H, W divisible by
+``patch``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from baton_tpu.core.losses import softmax_cross_entropy
+from baton_tpu.core.model import FedModel
+from baton_tpu.models.transformer import (
+    AttentionFn,
+    dense_init,
+    dot_product_attention,
+    layer_norm,
+    ln_init,
+    normal_init,
+    prenorm_block_apply,
+    prenorm_block_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch: int = 16
+    channels: int = 3
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    n_classes: int = 1000
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch) ** 2
+
+    @classmethod
+    def b16(cls, **kw) -> "ViTConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        """Test-sized config (CI / CPU-mesh tests)."""
+        defaults = dict(
+            image_size=16, patch=4, channels=3, d_model=32, n_layers=2,
+            n_heads=4, d_ff=64, n_classes=10,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def _patchify(x, patch):
+    """[B, H, W, C] -> [B, N, patch*patch*C] without convolution."""
+    b, h, w, c = x.shape
+    gh, gw = h // patch, w // patch
+    x = x.reshape(b, gh, patch, gw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+def vit_model(
+    config: Optional[ViTConfig] = None,
+    compute_dtype=jnp.float32,
+    attention_fn: AttentionFn = dot_product_attention,
+    name: str = "vit",
+) -> FedModel:
+    cfg = config or ViTConfig.b16()
+    patch_dim = cfg.patch * cfg.patch * cfg.channels
+
+    def init(rng):
+        keys = jax.random.split(rng, cfg.n_layers + 4)
+        return {
+            "patch_proj": {
+                "w": dense_init(keys[0], patch_dim, cfg.d_model),
+                "b": jnp.zeros((cfg.d_model,), jnp.float32),
+            },
+            "cls_token": normal_init(keys[1], (1, 1, cfg.d_model), 0.02),
+            "pos_emb": normal_init(
+                keys[2], (cfg.n_patches + 1, cfg.d_model), 0.02
+            ),
+            "blocks": [
+                prenorm_block_init(keys[3 + i], cfg.d_model, cfg.n_heads, cfg.d_ff)
+                for i in range(cfg.n_layers)
+            ],
+            "ln_f": ln_init(cfg.d_model),
+            "head": {
+                "w": dense_init(keys[-1], cfg.d_model, cfg.n_classes),
+                "b": jnp.zeros((cfg.n_classes,), jnp.float32),
+            },
+        }
+
+    def apply(params, batch, rng):
+        x = _patchify(batch["x"], cfg.patch).astype(compute_dtype)
+        x = x @ params["patch_proj"]["w"].astype(x.dtype) + params[
+            "patch_proj"
+        ]["b"].astype(x.dtype)
+        b = x.shape[0]
+        cls = jnp.broadcast_to(
+            params["cls_token"].astype(x.dtype), (b, 1, cfg.d_model)
+        )
+        x = jnp.concatenate([cls, x], axis=1) + params["pos_emb"].astype(x.dtype)
+        for blk in params["blocks"]:
+            x = prenorm_block_apply(blk, x, cfg.n_heads,
+                                    attention_fn=attention_fn)
+        x = layer_norm(x, params["ln_f"])
+        cls_out = x[:, 0, :].astype(jnp.float32)
+        return cls_out @ params["head"]["w"] + params["head"]["b"]
+
+    def per_example_loss(params, batch, rng):
+        return softmax_cross_entropy(apply(params, batch, rng), batch, rng)
+
+    return FedModel(init=init, apply=apply, per_example_loss=per_example_loss,
+                    name=name, aux=cfg)
