@@ -31,6 +31,7 @@ import optax
 
 from baton_tpu.core.model import Batch, FedModel, Params, PRNGKey
 from baton_tpu.core.partition import ParamPartition
+from baton_tpu.ops.privacy import DPConfig, dp_sgd_grads
 
 Regularizer = Callable[[Params, Params], jax.Array]
 
@@ -71,6 +72,9 @@ class LocalTrainer:
     batch_size: int
     regularizer: Optional[Regularizer] = None
     partition: Optional[ParamPartition] = None
+    # example-level DP-SGD (ops/privacy.py): per-example clipping +
+    # Gaussian noise replace the plain batch gradient when set
+    dp: Optional[DPConfig] = None
 
     def init_opt_state(self, params: Params):
         return self.optimizer.init(params)
@@ -110,21 +114,47 @@ class LocalTrainer:
         nb = num_batches(capacity, self.batch_size)
         n_samples = jnp.asarray(n_samples, jnp.int32)
 
+        def merged(p):
+            return self.partition.merge(p, frozen) if self.partition else p
+
         def objective(p, batch, step_rng):
-            full = self.partition.merge(p, frozen) if self.partition else p
-            data_loss_sum, count = self.model.loss_and_count(full, batch, step_rng)
+            loss_sum, count = self.model.loss_and_count(merged(p), batch, step_rng)
             denom = jnp.maximum(count, 1.0)
-            loss = data_loss_sum / denom
+            loss = loss_sum / denom
             if self.regularizer is not None:
                 loss = loss + self.regularizer(p, anchor)
-            return loss, (data_loss_sum, count)
+            return loss, (loss_sum, count)
 
         grad_fn = jax.value_and_grad(objective, has_aux=True)
+
+        def masked_loss_sum(p, batch, step_rng):
+            """Masked data-loss sum only (no regularizer) — the per-example
+            clipping target for DP-SGD; padding rows contribute exactly 0."""
+            s, _ = self.model.loss_and_count(merged(p), batch, step_rng)
+            return s
 
         def batch_step(carry, batch):
             p, os, step_rng = carry
             step_rng, sub = jax.random.split(step_rng)
-            (_, (loss_sum, count)), grads = grad_fn(p, batch, sub)
+            if self.dp is not None:
+                grads, ex_losses = dp_sgd_grads(
+                    masked_loss_sum, p, batch, sub, self.dp, self.batch_size
+                )
+                if self.regularizer is not None:
+                    # the prox term is data-independent: its gradient is
+                    # exact (un-noised) and consumes no privacy budget
+                    reg_grads = jax.grad(
+                        lambda q: self.regularizer(q, anchor)
+                    )(p)
+                    grads = jax.tree_util.tree_map(
+                        lambda g, r: (g + r).astype(g.dtype), grads, reg_grads
+                    )
+                # ex_losses are already mask-zeroed (masked_loss_sum);
+                # NOT privatized — see DPConfig docstring
+                loss_sum = jnp.sum(ex_losses)
+                count = jnp.sum(batch["mask"].astype(jnp.float32))
+            else:
+                (_, (loss_sum, count)), grads = grad_fn(p, batch, sub)
             # An all-padding batch yields exactly-zero grads; gate the
             # update so stateful optimizers (momentum/adam) don't mutate
             # state on phantom steps.
@@ -173,6 +203,7 @@ def make_local_trainer(
     learning_rate: float = 1e-3,
     regularizer: Optional[Regularizer] = None,
     partition: Optional[ParamPartition] = None,
+    dp: Optional[DPConfig] = None,
 ) -> LocalTrainer:
     """Build a :class:`LocalTrainer`.
 
@@ -187,6 +218,7 @@ def make_local_trainer(
         batch_size=batch_size,
         regularizer=regularizer,
         partition=partition,
+        dp=dp,
     )
 
 

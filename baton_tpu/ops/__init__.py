@@ -6,6 +6,18 @@ from baton_tpu.ops.aggregation import (
     tree_unstack,
 )
 from baton_tpu.ops.padding import pad_dataset, pad_to_capacity
+from baton_tpu.ops.privacy import (
+    DPConfig,
+    clip_by_global_norm,
+    dp_fedavg,
+    global_norm,
+    rdp_epsilon,
+)
+from baton_tpu.ops.secure_agg import (
+    aggregate_masked,
+    mask_update,
+    net_mask_of,
+)
 
 __all__ = [
     "weighted_tree_mean",
@@ -15,4 +27,12 @@ __all__ = [
     "tree_unstack",
     "pad_dataset",
     "pad_to_capacity",
+    "DPConfig",
+    "clip_by_global_norm",
+    "dp_fedavg",
+    "global_norm",
+    "rdp_epsilon",
+    "aggregate_masked",
+    "mask_update",
+    "net_mask_of",
 ]

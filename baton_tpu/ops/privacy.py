@@ -1,0 +1,220 @@
+"""Differential privacy — DP-SGD and client-level DP aggregation.
+
+The reference has no privacy machinery at all (clients ship raw
+state_dicts, reference worker.py:108-124); BASELINE config 5 (ViT-B/16
+cross-silo with DP-SGD + secure aggregation) is a driver-set workload.
+Two granularities, composable:
+
+* **Example-level DP-SGD** inside local training: per-example gradients
+  are one ``vmap`` over the framework's per-example loss contract
+  (core/model.py — the contract exists partly *for* this), clipped to
+  ``clip_norm`` each, summed, Gaussian-noised at ``noise_multiplier *
+  clip_norm``, and averaged over the **static** batch size (padding rows
+  have exactly-zero gradients, so they are clipped no-ops and the lot
+  size stays data-independent, as the DP analysis requires). Enabled by
+  passing :class:`DPConfig` to the trainer/engine.
+* **Client-level DP** at aggregation: each client's round delta is
+  clipped in global L2 norm, deltas are **uniformly** averaged (weighting
+  by private sample counts would leak them into sensitivity), and
+  Gaussian noise of std ``noise_multiplier * clip_norm / n_clients`` is
+  added to the mean — the DP-FedAvg recipe.
+
+Accounting is Rényi-DP for the Gaussian mechanism: each step/round is
+``(α, α/(2σ²))``-RDP; compositions add; conversion to (ε, δ) minimizes
+over orders. No subsampling amplification is claimed (the bound is
+valid — conservative — for sampled cohorts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class DPConfig:
+    """Hashable DP-SGD settings (rides inside jit-static trainer fields).
+
+    ``noise_multiplier`` is σ in the DP literature: noise std per step is
+    ``noise_multiplier * clip_norm`` on the *summed* clipped gradients.
+
+    **Scope of the guarantee**: the RDP accounting covers the *gradients*
+    (and therefore the released model parameters). Reported training
+    losses (``loss_history`` / ``RoundResult.client_losses``) are exact
+    functions of the private data and are NOT privatized — treat them as
+    diagnostics for trusted eyes only, or suppress them at the release
+    boundary (``FedSim.run_round(collect_client_losses=False)``).
+    """
+
+    clip_norm: float
+    noise_multiplier: float
+
+
+def global_norm(tree: Params) -> jax.Array:
+    """L2 norm over every leaf of a pytree, fp32."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    )
+
+
+def clip_by_global_norm(tree: Params, max_norm) -> Params:
+    """Scale ``tree`` so its global L2 norm is at most ``max_norm``."""
+    norm = global_norm(tree)
+    factor = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(
+        lambda l: (l.astype(jnp.float32) * factor).astype(l.dtype), tree
+    )
+
+
+def per_example_clipped_grad_sum(loss_fn, params: Params, batch, rng,
+                                 clip_norm):
+    """Returns (Σ_i clip(∇ loss_fn(params, example_i), clip_norm),
+    per-example losses [B]).
+
+    ``loss_fn(params, single_example_batch, rng) -> scalar`` where the
+    batch dict has leading dim 1. Per-example gradients are a vmap over
+    the batch axis; each is clipped to ``clip_norm`` in global L2 before
+    summation — the DP-SGD sensitivity bound. Losses fall out of the
+    same value_and_grad pass (no extra forward) and are NOT part of the
+    DP guarantee (see :class:`DPConfig`).
+    """
+
+    def single(p, example):
+        batch1 = jax.tree_util.tree_map(lambda a: a[None], example)
+        return loss_fn(p, batch1, rng)
+
+    losses, grads = jax.vmap(
+        jax.value_and_grad(single), in_axes=(None, 0)
+    )(params, batch)
+    # per-example global norms: reduce every leaf over all but axis 0
+    sq = [
+        jnp.sum(jnp.square(g.astype(jnp.float32)),
+                axis=tuple(range(1, g.ndim)))
+        for g in jax.tree_util.tree_leaves(grads)
+    ]
+    norms = jnp.sqrt(sum(sq))  # [B]
+    factors = jnp.minimum(1.0, clip_norm / jnp.maximum(norms, 1e-12))
+
+    def clip_and_sum(g):
+        f = factors.reshape((-1,) + (1,) * (g.ndim - 1))
+        return jnp.sum(g.astype(jnp.float32) * f, axis=0)
+
+    return jax.tree_util.tree_map(clip_and_sum, grads), losses
+
+
+def gaussian_noise_like(tree: Params, std, rng) -> Params:
+    """Independent N(0, std²) per element, one subkey per leaf."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(rng, len(leaves))
+    noised = [
+        jax.random.normal(k, l.shape, jnp.float32) * std
+        for k, l in zip(keys, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, noised)
+
+
+def dp_sgd_grads(loss_fn, params: Params, batch, rng, dp: DPConfig,
+                 batch_size: int):
+    """The DP-SGD gradient estimator: clipped per-example sum + noise,
+    averaged over the static lot size.
+
+    Returns ``(grads, per_example_losses)``; gradient leaves keep the
+    parameter dtypes (lax.scan carries must be dtype-stable)."""
+    grad_rng, noise_rng = jax.random.split(rng)
+    summed, losses = per_example_clipped_grad_sum(
+        loss_fn, params, batch, grad_rng, dp.clip_norm
+    )
+    noise = gaussian_noise_like(
+        summed, dp.noise_multiplier * dp.clip_norm, noise_rng
+    )
+    grads = jax.tree_util.tree_map(
+        lambda g, n, p: ((g + n) / batch_size).astype(p.dtype),
+        summed, noise, params,
+    )
+    return grads, losses
+
+
+# ---------------------------------------------------------------------------
+# client-level DP aggregation (DP-FedAvg)
+
+
+def dp_client_deltas(stacked_params: Params, global_params: Params,
+                     clip_norm) -> Params:
+    """Per-client round deltas clipped to ``clip_norm`` in global L2.
+
+    ``stacked_params`` has a leading client axis on every leaf.
+    """
+
+    def delta(stacked_leaf, global_leaf):
+        return stacked_leaf.astype(jnp.float32) - global_leaf.astype(jnp.float32)
+
+    deltas = jax.tree_util.tree_map(delta, stacked_params, global_params)
+    sq = [
+        jnp.sum(jnp.square(l), axis=tuple(range(1, l.ndim)))
+        for l in jax.tree_util.tree_leaves(deltas)
+    ]
+    norms = jnp.sqrt(sum(sq))  # [C]
+    factors = jnp.minimum(1.0, clip_norm / jnp.maximum(norms, 1e-12))
+
+    def clip(l):
+        return l * factors.reshape((-1,) + (1,) * (l.ndim - 1))
+
+    return jax.tree_util.tree_map(clip, deltas)
+
+
+def dp_fedavg(stacked_params: Params, global_params: Params, rng,
+              clip_norm, noise_multiplier) -> Params:
+    """DP-FedAvg: uniform mean of clipped client deltas + Gaussian noise.
+
+    Replaces sample-weighted FedAvg (reference manager.py:119-126
+    semantics) when client-level DP is on: weighting by private
+    ``n_samples`` would make sensitivity data-dependent, so the mean is
+    uniform and the noise std is ``noise_multiplier * clip_norm / C``.
+    Returns new global params (same dtypes as ``global_params``).
+    """
+    deltas = dp_client_deltas(stacked_params, global_params, clip_norm)
+    n_clients = jax.tree_util.tree_leaves(deltas)[0].shape[0]
+    mean_delta = jax.tree_util.tree_map(
+        lambda l: jnp.mean(l, axis=0), deltas
+    )
+    noise = gaussian_noise_like(
+        mean_delta, noise_multiplier * clip_norm / n_clients, rng
+    )
+    return jax.tree_util.tree_map(
+        lambda g, d, n: (g.astype(jnp.float32) + d + n).astype(g.dtype),
+        global_params, mean_delta, noise,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Rényi-DP accounting (Gaussian mechanism, exact composition)
+
+DEFAULT_ORDERS = tuple([1.25, 1.5, 2.0, 2.5, 3.0, 4.0, 5.0, 6.0, 8.0, 10.0,
+                        16.0, 32.0, 64.0, 128.0, 256.0])
+
+
+def rdp_epsilon(noise_multiplier: float, steps: int, delta: float,
+                orders: Sequence[float] = DEFAULT_ORDERS) -> float:
+    """(ε, δ)-DP spent by ``steps`` Gaussian mechanisms of parameter σ.
+
+    Each step is (α, α/(2σ²))-RDP; RDP composes additively; the
+    conversion ε = min_α [T·α/(2σ²) + log(1/δ)/(α−1)] uses the standard
+    RDP→DP bound. Conservative under subsampling (no amplification
+    claimed).
+    """
+    if noise_multiplier <= 0:
+        return float("inf")
+    sigma2 = noise_multiplier ** 2
+    eps = [
+        steps * a / (2.0 * sigma2) + np.log(1.0 / delta) / (a - 1.0)
+        for a in orders
+        if a > 1.0
+    ]
+    return float(min(eps))

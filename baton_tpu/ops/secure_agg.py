@@ -1,0 +1,145 @@
+"""Secure aggregation — pairwise-masked sums in a finite field.
+
+The reference manager sees every client's raw weights (reference
+manager.py:95-126); BASELINE config 5 requires the server to learn
+*only the sum*. This implements the standard pairwise-masking core
+(Bonawitz et al.-style):
+
+* Updates are **fixed-point quantized** into the ring Z_2^32
+  (:func:`quantize` / :func:`dequantize`) — masking must be exact, and
+  float addition is not associative; uint32 modular arithmetic is.
+* For every client pair ``i < j`` a mask tree is derived from a shared
+  pairwise key (``jax.random.fold_in`` chain — stands in for the
+  Diffie-Hellman agreed seed of the real protocol); client ``i`` adds
+  it, client ``j`` subtracts it, so the masks cancel **exactly** in the
+  modular sum and any single masked update is uniform noise to the
+  server.
+* **Dropout recovery**: if clients drop after masking, the survivors'
+  sum still contains their uncancelled pairwise masks.
+  :func:`net_mask_of` recomputes any client's net mask so the server can
+  subtract the residue (the real protocol gates this on secret-shared
+  seed recovery; the HTTP edge owns that handshake — this is the
+  primitive).
+
+This module is **host-side by design** (numpy uint32, not jnp): it runs
+at the HTTP edge where real clients ship updates to an untrusted
+aggregator, exact 32-bit modular arithmetic is required (JAX defaults to
+32-bit-only and would truncate the intermediate 64-bit products), and
+there is nothing here for the MXU to accelerate. For simulated cohorts
+prefer :mod:`baton_tpu.ops.aggregation` — the server is the same
+process, so there is nothing to hide. Costs are the protocol's inherent
+O(C²) pairwise masks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+Params = Any
+
+DEFAULT_SCALE_BITS = 16  # fixed-point fractional bits
+_RING = 1 << 32
+
+
+def quantize(tree: Params, scale_bits: int = DEFAULT_SCALE_BITS) -> Params:
+    """Float pytree -> uint32 fixed-point (two's complement in Z_2^32).
+
+    Exact for magnitudes < 2^(31 - scale_bits - log2 C) summed over C
+    clients; callers clip updates (ops/privacy.py) before quantizing.
+    """
+    scale = float(1 << scale_bits)
+
+    def one(leaf):
+        q = np.round(np.asarray(leaf, np.float64) * scale).astype(np.int64)
+        return (q % _RING).astype(np.uint32)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def dequantize(tree: Params, scale_bits: int = DEFAULT_SCALE_BITS) -> Params:
+    """uint32 ring elements -> float64, values >= 2^31 read as negative."""
+    scale = float(1 << scale_bits)
+
+    def one(leaf):
+        v = np.asarray(leaf, np.int64)
+        v = np.where(v >= _RING // 2, v - _RING, v)
+        return v.astype(np.float64) / scale
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def _pair_key(seed_key, i: int, j: int):
+    """Shared key for the (unordered) pair i<j."""
+    lo, hi = (i, j) if i < j else (j, i)
+    return jax.random.fold_in(jax.random.fold_in(seed_key, lo), hi)
+
+
+def _mask_tree(key, template: Params) -> Params:
+    """Uniform uint32 ring elements shaped like ``template``."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    keys = jax.random.split(key, len(leaves))
+    masks = [
+        np.asarray(jax.random.bits(k, np.shape(l), "uint32"))
+        for k, l in zip(keys, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, masks)
+
+
+def net_mask_of(seed_key, client: int, n_clients: int,
+                template: Params) -> Params:
+    """Client's total mask: Σ_{j>c} m(c,j) − Σ_{j<c} m(j,c)  (mod 2^32)."""
+    total = jax.tree_util.tree_map(
+        lambda l: np.zeros(np.shape(l), np.uint32), template
+    )
+    for other in range(n_clients):
+        if other == client:
+            continue
+        mask = _mask_tree(_pair_key(seed_key, client, other), template)
+        if other > client:
+            total = jax.tree_util.tree_map(
+                lambda t, m: (t + m).astype(np.uint32), total, mask
+            )
+        else:
+            total = jax.tree_util.tree_map(
+                lambda t, m: (t - m).astype(np.uint32), total, mask
+            )
+    return total
+
+
+def mask_update(update: Params, seed_key, client: int, n_clients: int,
+                scale_bits: int = DEFAULT_SCALE_BITS) -> Params:
+    """Client-side: quantize and add the net pairwise mask (mod 2^32)."""
+    q = quantize(update, scale_bits)
+    mask = net_mask_of(seed_key, client, n_clients, q)
+    return jax.tree_util.tree_map(
+        lambda a, m: (a + m).astype(np.uint32), q, mask
+    )
+
+
+def aggregate_masked(masked_updates: Sequence[Params],
+                     scale_bits: int = DEFAULT_SCALE_BITS,
+                     dropped_net_masks: Sequence[Params] = ()) -> Params:
+    """Server-side: modular sum of masked updates -> dequantized float sum.
+
+    With a full cohort the pairwise masks cancel identically. If clients
+    dropped after masking, pass their :func:`net_mask_of` trees: the
+    survivors' residual masks toward a dropped client sum to exactly the
+    negation of that client's net mask, so adding it cancels the residue.
+    """
+    total = jax.tree_util.tree_map(
+        lambda l: np.asarray(l, np.uint32), masked_updates[0]
+    )
+    for u in masked_updates[1:]:
+        total = jax.tree_util.tree_map(
+            lambda a, b: (a + np.asarray(b, np.uint32)).astype(np.uint32),
+            total, u,
+        )
+    for m in dropped_net_masks:
+        total = jax.tree_util.tree_map(
+            lambda a, b: (a + np.asarray(b, np.uint32)).astype(np.uint32),
+            total, m,
+        )
+    return dequantize(total, scale_bits)

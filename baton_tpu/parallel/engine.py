@@ -79,6 +79,7 @@ class FedSim:
         mesh: Optional[Mesh] = None,
         regularizer=None,
         trainable: Optional[PathPredicate] = None,
+        dp=None,
     ):
         self.model = model
         self.trainer: LocalTrainer = make_local_trainer(
@@ -87,6 +88,7 @@ class FedSim:
             batch_size=batch_size,
             learning_rate=learning_rate,
             regularizer=regularizer,
+            dp=dp,
         )
         self.server_optimizer = server_optimizer
         self.mesh = mesh
