@@ -1,5 +1,11 @@
 from baton_tpu.parallel.mesh import make_mesh, client_sharding, replicated_sharding
 from baton_tpu.parallel.engine import FedSim, RoundResult
+from baton_tpu.parallel.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+    make_ring_attention_fn,
+    make_ulysses_attention_fn,
+)
 
 __all__ = [
     "make_mesh",
@@ -7,4 +13,8 @@ __all__ = [
     "replicated_sharding",
     "FedSim",
     "RoundResult",
+    "ring_attention",
+    "ulysses_attention",
+    "make_ring_attention_fn",
+    "make_ulysses_attention_fn",
 ]

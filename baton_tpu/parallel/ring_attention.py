@@ -1,0 +1,202 @@
+"""Sequence parallelism: ring attention and Ulysses (all-to-all) attention.
+
+The reference has no long-context machinery of any kind (SURVEY §5 —
+its demo model is a 10->1 linear layer, reference demo.py:15-49); these
+kernels exist so the transformer zoo scales past one chip's HBM on
+sequence length, the TPU way:
+
+* **Ring attention** (:func:`ring_attention`): K/V blocks rotate around
+  the mesh axis via ``lax.ppermute`` (ICI neighbor exchange — the
+  topology ring attention was designed for) while each device's Q stays
+  put, accumulating exact softmax attention with the online
+  (max/sum-rescaling) recurrence. N steps, each overlapping a block
+  matmul with a neighbor push; memory per device is O(L/N · L/N)
+  scores, never the full L×L.
+* **Ulysses attention** (:func:`ulysses_attention`): two
+  ``lax.all_to_all``s swap sequence-sharding for head-sharding, run
+  dense local attention over the full sequence for H/N heads, and swap
+  back. Cheaper collectives for moderate L; requires heads % devices
+  == 0 (ring has no such constraint).
+
+Both are exact (not approximations) and drop into any model in the zoo
+through the ``attention_fn`` seam (:mod:`baton_tpu.models.transformer`)
+via :func:`make_ring_attention_fn` / :func:`make_ulysses_attention_fn`,
+which shard_map the [B, H, L, Dh] tensors over a sequence mesh axis at
+the attention boundary. Padding biases are not supported under sequence
+parallelism (pack or pad-to-block instead); causal masking is computed
+from global positions and is exact.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+_NEG = -1e30
+
+
+def _block_scores(q, k, scale):
+    """[B,Hq,Lq,Dh] x [B,Hkv,Lk,Dh] -> fp32 [B,Hq,Lq,Lk] with GQA
+    head-grouping (query head h reads kv head h // (Hq//Hkv))."""
+    b, hq, lq, dh = q.shape
+    hkv, lk = k.shape[1], k.shape[2]
+    if hq != hkv:
+        qg = q.reshape(b, hkv, hq // hkv, lq, dh)
+        s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k).reshape(b, hq, lq, lk)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    return s.astype(jnp.float32) * scale
+
+
+def _block_pv(p, v, hq):
+    """[B,Hq,Lq,Lk] probs x [B,Hkv,Lk,Dh] -> [B,Hq,Lq,Dh], GQA-grouped."""
+    b, _, lq, lk = p.shape
+    hkv = v.shape[1]
+    if hq != hkv:
+        pg = p.reshape(b, hkv, hq // hkv, lq, lk)
+        return jnp.einsum("bhgqk,bhkd->bhgqd", pg, v).reshape(
+            b, hq, lq, v.shape[3]
+        )
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
+                   bias=None):
+    """Exact attention with K/V ring-rotated over ``axis_name``.
+
+    Call inside ``shard_map`` with q, k, v sharded on the length axis
+    ([B, H, L/N, Dh] per device). The online-softmax carry (running max
+    ``m``, normalizer ``l``, accumulator ``o``) is rescaled as each new
+    K/V block arrives, so the result is bit-for-bit a softmax over the
+    full sequence, never materializing L×L scores.
+    """
+    if bias is not None:
+        raise NotImplementedError(
+            "padding bias under ring attention is unsupported; pack "
+            "sequences or pad to the block boundary"
+        )
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, hq, lc, dh = q.shape
+    scale = dh ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    qf = q.astype(jnp.float32)
+    # carries start device-invariant but become device-varying inside the
+    # loop; mark them varying up front so the fori_loop types are stable
+    def varying(x):
+        return lax.pcast(x, (axis_name,), to="varying")
+
+    o = varying(jnp.zeros((b, hq, lc, dh), jnp.float32))
+    m = varying(jnp.full((b, hq, lc), _NEG, jnp.float32))
+    l = varying(jnp.zeros((b, hq, lc), jnp.float32))
+
+    def step(s, carry):
+        o, m, l, k_cur, v_cur = carry
+        # after s forward rotations, this device holds the block that
+        # originated on device (my - s) mod n
+        src = (my - s) % n
+        scores = _block_scores(qf, k_cur.astype(jnp.float32), scale)
+        if causal:
+            q_pos = my * lc + jnp.arange(lc)
+            k_pos = src * lc + jnp.arange(lc)
+            scores = jnp.where(
+                q_pos[:, None] >= k_pos[None, :], scores, _NEG
+            )
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        # fully-masked entries: exp(NEG - NEG) == 1 must be zeroed
+        p = jnp.where(scores > _NEG / 2, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + _block_pv(p, v_cur.astype(jnp.float32), hq)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return o, m_new, l, k_next, v_next
+
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o, m, l, k, v))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
+                      causal: bool = False, bias=None):
+    """Exact attention via head<->sequence all-to-all re-sharding.
+
+    Call inside ``shard_map`` with q, k, v sharded on length. Each
+    device ends up with the *full* sequence for H/N heads, runs the
+    dense kernel, and re-shards back to length. Requires both the query
+    and kv head counts to be divisible by the axis size.
+    """
+    if bias is not None:
+        raise NotImplementedError(
+            "padding bias under Ulysses attention is unsupported; pack "
+            "sequences or pad to the block boundary"
+        )
+    from baton_tpu.models.transformer import dot_product_attention
+
+    n = lax.psum(1, axis_name)
+
+    def to_heads(x):
+        # [B, H, L/N, Dh] -> [B, H/N, L, Dh]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    out = dot_product_attention(
+        to_heads(q), to_heads(k), to_heads(v), causal=causal
+    )
+    return to_seq(out)
+
+
+def _seq_sharded_fn(kernel, mesh: Mesh, axis_name: str):
+    spec = P(None, None, axis_name, None)
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    def sharded(q, k, v):
+        return kernel(q, k, v)
+
+    return sharded
+
+
+def make_ring_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
+    """An ``attention_fn`` for the model zoo: shards [B, H, L, Dh] over
+    ``mesh[axis_name]`` on L and runs :func:`ring_attention`. The
+    sequence length must be divisible by the axis size."""
+
+    def attention_fn(q, k, v, bias=None, causal=False):
+        if bias is not None:
+            raise NotImplementedError("no padding bias under ring attention")
+        kernel = partial(ring_attention, axis_name=axis_name, causal=causal)
+        return _seq_sharded_fn(kernel, mesh, axis_name)(q, k, v)
+
+    return attention_fn
+
+
+def make_ulysses_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
+    """An ``attention_fn`` for the model zoo backed by
+    :func:`ulysses_attention`. Head counts must be divisible by the
+    axis size."""
+
+    def attention_fn(q, k, v, bias=None, causal=False):
+        if bias is not None:
+            raise NotImplementedError(
+                "no padding bias under Ulysses attention"
+            )
+        kernel = partial(ulysses_attention, axis_name=axis_name,
+                         causal=causal)
+        return _seq_sharded_fn(kernel, mesh, axis_name)(q, k, v)
+
+    return attention_fn

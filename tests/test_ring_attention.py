@@ -1,0 +1,117 @@
+"""Sequence parallelism: ring + Ulysses attention vs the dense oracle.
+
+Both kernels are exact algorithms — outputs must match dense attention
+to float tolerance on an 8-device CPU mesh, across causal/non-causal and
+GQA shapes, and end-to-end inside the Llama decoder.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from baton_tpu.models.llama import LlamaConfig, llama_lm_model
+from baton_tpu.models.transformer import dot_product_attention
+from baton_tpu.parallel.mesh import make_mesh
+from baton_tpu.parallel.ring_attention import (
+    make_ring_attention_fn,
+    make_ulysses_attention_fn,
+)
+
+
+def _qkv(nprng, b=2, hq=8, hkv=8, l=32, dh=4):
+    q = jnp.asarray(nprng.normal(size=(b, hq, l, dh)), jnp.float32)
+    k = jnp.asarray(nprng.normal(size=(b, hkv, l, dh)), jnp.float32)
+    v = jnp.asarray(nprng.normal(size=(b, hkv, l, dh)), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_dense(nprng, causal):
+    mesh = make_mesh(8, axis_names=("seq",))
+    q, k, v = _qkv(nprng)
+    ring = make_ring_attention_fn(mesh)
+    out = ring(q, k, v, causal=causal)
+    oracle = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gqa(nprng, causal):
+    mesh = make_mesh(4, axis_names=("seq",))
+    q, k, v = _qkv(nprng, hq=8, hkv=2, l=16)
+    ring = make_ring_attention_fn(mesh)
+    out = ring(q, k, v, causal=causal)
+    oracle = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense(nprng, causal):
+    mesh = make_mesh(8, axis_names=("seq",))
+    q, k, v = _qkv(nprng, hq=8, hkv=8)
+    ulysses = make_ulysses_attention_fn(mesh)
+    out = ulysses(q, k, v, causal=causal)
+    oracle = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_rejects_bias(nprng):
+    mesh = make_mesh(2, axis_names=("seq",))
+    q, k, v = _qkv(nprng, l=8)
+    ring = make_ring_attention_fn(mesh)
+    with pytest.raises(NotImplementedError):
+        ring(q, k, v, bias=jnp.zeros((2, 1, 1, 8)))
+
+
+def test_llama_with_ring_attention_matches_dense(nprng):
+    """The attention_fn seam end-to-end: same params, same tokens, ring
+    vs dense decoder forward passes agree."""
+    cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4, max_len=32)
+    mesh = make_mesh(8, axis_names=("seq",))
+    dense_model = llama_lm_model(cfg)
+    ring_model = llama_lm_model(
+        cfg, attention_fn=make_ring_attention_fn(mesh), name="llama_ring"
+    )
+    params = dense_model.init(jax.random.key(0))
+    x = jnp.asarray(
+        nprng.integers(0, cfg.vocab_size, size=(2, cfg.max_len)), jnp.int32
+    )
+    batch = {"x": x, "y": x}
+    out_dense = dense_model.apply(params, batch, jax.random.key(1))
+    out_ring = ring_model.apply(params, batch, jax.random.key(1))
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_llama_ring_attention_grads_flow(nprng):
+    """Ring attention must be differentiable (training path, not just
+    inference): grads through the sharded kernel are finite and match
+    dense-attention grads."""
+    cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=4, max_len=16)
+    mesh = make_mesh(4, axis_names=("seq",))
+    dense_model = llama_lm_model(cfg)
+    ring_model = llama_lm_model(
+        cfg, attention_fn=make_ring_attention_fn(mesh), name="llama_ring"
+    )
+    params = dense_model.init(jax.random.key(0))
+    x = jnp.asarray(
+        nprng.integers(0, cfg.vocab_size, size=(2, cfg.max_len)), jnp.int32
+    )
+    batch = {"x": x, "y": x}
+
+    def loss(model):
+        return lambda p: jnp.mean(
+            model.per_example_loss(p, batch, jax.random.key(1))
+        )
+
+    g_dense = jax.grad(loss(dense_model))(params)
+    g_ring = jax.grad(loss(ring_model))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ring),
+                    jax.tree_util.tree_leaves(g_dense)):
+        assert np.all(np.isfinite(np.asarray(a)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4)
