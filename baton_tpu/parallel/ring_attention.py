@@ -99,8 +99,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
     m = varying(jnp.full((b, hq, lc), _NEG, jnp.float32))
     l = varying(jnp.zeros((b, hq, lc), jnp.float32))
 
-    def step(s, carry):
-        o, m, l, k_cur, v_cur = carry
+    def accum(s, o, m, l, k_cur, v_cur):
         # after s forward rotations, this device holds the block that
         # originated on device (my - s) mod n
         src = (my - s) % n
@@ -118,11 +117,21 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1)
         o = o * corr[..., None] + _block_pv(p, v_cur.astype(jnp.float32), hq)
-        k_next = lax.ppermute(k_cur, axis_name, perm)
-        v_next = lax.ppermute(v_cur, axis_name, perm)
-        return o, m_new, l, k_next, v_next
+        return o, m_new, l
 
-    o, m, l, _, _ = lax.fori_loop(0, n, step, (o, m, l, k, v))
+    def step(s, carry):
+        o, m, l, k_cur, v_cur = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        o, m, l = accum(s, o, m, l, k_cur, v_cur)
+        return o, m, l, k_cur, v_cur
+
+    # step 0 is peeled (local block needs no rotation) and the rotation
+    # happens at the top of each remaining step, so exactly n-1 ppermute
+    # pairs are issued — a tail rotation whose result is discarded would
+    # otherwise waste one neighbor-exchange of full K/V per layer per step
+    o, m, l = accum(0, o, m, l, k, v)
+    o, m, l, _, _ = lax.fori_loop(1, n, step, (o, m, l, k, v))
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
@@ -179,6 +188,12 @@ def make_ring_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
     def attention_fn(q, k, v, bias=None, causal=False):
         if bias is not None:
             raise NotImplementedError("no padding bias under ring attention")
+        n = mesh.shape[axis_name]
+        if q.shape[2] % n:
+            raise ValueError(
+                f"ring attention needs sequence length divisible by mesh "
+                f"axis {axis_name!r} size {n}; got L={q.shape[2]}"
+            )
         kernel = partial(ring_attention, axis_name=axis_name, causal=causal)
         return _seq_sharded_fn(kernel, mesh, axis_name)(q, k, v)
 
@@ -194,6 +209,20 @@ def make_ulysses_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
         if bias is not None:
             raise NotImplementedError(
                 "no padding bias under Ulysses attention"
+            )
+        n = mesh.shape[axis_name]
+        hq, hkv = q.shape[1], k.shape[1]
+        if hq % n or hkv % n:
+            raise ValueError(
+                f"Ulysses attention needs query AND kv head counts "
+                f"divisible by mesh axis {axis_name!r} size {n}; got "
+                f"Hq={hq}, Hkv={hkv} (use ring attention for GQA models "
+                f"whose kv heads don't divide)"
+            )
+        if q.shape[2] % n:
+            raise ValueError(
+                f"Ulysses attention needs sequence length divisible by "
+                f"mesh axis {axis_name!r} size {n}; got L={q.shape[2]}"
             )
         kernel = partial(ulysses_attention, axis_name=axis_name,
                          causal=causal)

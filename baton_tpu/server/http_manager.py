@@ -46,6 +46,7 @@ from baton_tpu.server.registry import AuthError, ClientRegistry, UnknownClient
 from baton_tpu.server.rounds import RoundInProgress, RoundManager
 from baton_tpu.server.state import params_to_state_dict, state_dict_to_params
 from baton_tpu.server.utils import PeriodicTask, json_clean
+from baton_tpu.utils.metrics import Metrics
 
 DEFAULT_N_EPOCH = 32  # reference manager.py:52-55
 
@@ -85,6 +86,9 @@ class Experiment:
         allow_pickle: bool = False,
         rng_seed: int = 0,
         start_background_tasks: bool = True,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_keep: int = 3,
+        metrics: Optional[Metrics] = None,
     ):
         self.name = name
         self.app = app
@@ -92,6 +96,23 @@ class Experiment:
         self.params = params if params is not None else model.init(jax.random.key(rng_seed))
         self.registry = ClientRegistry(name, client_ttl=client_ttl)
         self.rounds = RoundManager(name, round_timeout=round_timeout)
+        self.metrics = metrics or Metrics()
+        self.checkpointer = None
+        if checkpoint_dir is not None:
+            from baton_tpu.utils.checkpoint import Checkpointer
+
+            self.checkpointer = Checkpointer(
+                checkpoint_dir, max_to_keep=checkpoint_keep
+            )
+            restored = self.checkpointer.restore(self.params)
+            if restored is not None:
+                # Manager restart resumes the federation (the reference
+                # lost the global model here, SURVEY §5 checkpoint row).
+                self.params = restored.params
+                self.rounds.restore(
+                    restored.meta.get("n_rounds", restored.step),
+                    restored.meta.get("loss_history", []),
+                )
         self.allow_pickle = allow_pickle
         self.simulator = None  # (FedSim, data, n_samples) triple when attached
         self._sim_args: Optional[dict] = None
@@ -118,10 +139,13 @@ class Experiment:
             await task.stop()
         if self.__session is not None:
             await self.__session.close()
+        if self.checkpointer is not None:
+            self.checkpointer.close()
 
     async def _cull_tick(self) -> None:
         for cid in self.registry.cull():
             self.rounds.drop_client(cid)
+            self.metrics.inc("clients_culled")
         self._maybe_finish()
 
     async def _watchdog_tick(self) -> None:
@@ -144,6 +168,7 @@ class Experiment:
         r.add_get(f"/{self.name}/end_round", self.handle_end_round)
         r.add_get(f"/{self.name}/loss_history", self.handle_loss_history)
         r.add_post(f"/{self.name}/update", self.handle_update)
+        r.add_get(f"/{self.name}/metrics", self.handle_metrics)
 
     # -- membership ----------------------------------------------------
     async def handle_register(self, request: web.Request) -> web.Response:
@@ -189,6 +214,13 @@ class Experiment:
     async def handle_loss_history(self, request: web.Request) -> web.Response:
         return web.json_response([float(x) for x in self.rounds.loss_history])
 
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        snap = self.metrics.snapshot()
+        snap["gauges"]["clients_registered"] = float(len(self.registry))
+        snap["gauges"]["rounds_completed"] = float(self.rounds.n_rounds)
+        snap["gauges"]["round_in_progress"] = float(self.rounds.in_progress)
+        return web.json_response(snap)
+
     async def handle_update(self, request: web.Request) -> web.Response:
         try:
             client_id = self.registry.verify(
@@ -219,6 +251,7 @@ class Experiment:
             },
         )
         self.registry.record_update(client_id, round_name)
+        self.metrics.inc("updates_received")
         self._maybe_finish()
         return web.json_response("OK")
 
@@ -341,7 +374,9 @@ class Experiment:
         if not self.rounds.in_progress:
             return
         n_epoch = (self.rounds.round_meta or {}).get("n_epoch", 0)
+        self.metrics.observe("round_s", self.rounds.elapsed)
         responses = self.rounds.end_round()
+        self.metrics.inc("rounds_finished")
         reports = [r for r in responses.values() if r.get("n_samples", 0) > 0]
         if not reports:
             return
@@ -365,6 +400,25 @@ class Experiment:
             )
             if den:
                 self.rounds.loss_history.append(num / den)
+        if self.checkpointer is not None:
+            # wait=False: end_round runs on the event loop (handle_update
+            # → _maybe_finish → here); a synchronous orbax write would
+            # stall heartbeat handling and can get live clients culled.
+            # Orbax serializes concurrent saves internally and writes
+            # atomically (temp dir + rename); close() drains in-flight
+            # saves on shutdown.
+            with self.metrics.timer("checkpoint_s"):
+                self.checkpointer.save(
+                    self.rounds.n_rounds,
+                    self.params,
+                    meta={
+                        "n_rounds": self.rounds.n_rounds,
+                        "loss_history": [
+                            float(x) for x in self.rounds.loss_history
+                        ],
+                    },
+                    wait=False,
+                )
 
     def round_state(self) -> dict:
         return {

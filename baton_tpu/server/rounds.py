@@ -75,7 +75,14 @@ class RoundManager:
         """True when the running round has outlived ``round_timeout``."""
         if not self._in_progress or self.round_timeout is None:
             return False
-        return (self._clock() - self.started_at) > self.round_timeout
+        return self.elapsed > self.round_timeout
+
+    @property
+    def elapsed(self) -> float:
+        """Seconds since the running round started (0 outside a round)."""
+        if not self._in_progress or self.started_at is None:
+            return 0.0
+        return self._clock() - self.started_at
 
     def __len__(self) -> int:
         return len(self.clients) if self._in_progress else 0
@@ -116,6 +123,17 @@ class RoundManager:
         self._in_progress = False
         self.n_rounds += 1
         return self.client_responses
+
+    def restore(self, n_rounds: int, loss_history) -> None:
+        """Resume from checkpointed state: set the round counter and loss
+        history and recompute the derived round name. The single entry
+        point for manager restart-resume — keeps the name/counter
+        invariant here instead of in callers."""
+        if self._in_progress:
+            raise RoundInProgress(self.round_name)
+        self.n_rounds = int(n_rounds)
+        self.loss_history = list(loss_history)
+        self._reset_state()
 
     def abort_round(self) -> None:
         """Cancel a round without counting it (e.g. no client accepted

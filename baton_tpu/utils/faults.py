@@ -1,0 +1,90 @@
+"""HTTP-layer fault injection for elasticity testing.
+
+The reference's failure handling (heartbeat/TTL cull, eager eviction,
+401 re-register — SURVEY §3.3) was only ever exercised by manually
+killing processes; there is no fault *injection* anywhere in its tree
+(SURVEY §5). This module makes those paths testable deterministically:
+an aiohttp middleware that, per matching route, can
+
+* ``error`` — short-circuit with an HTTP status (e.g. 503 heartbeat
+  outage, 404 "wrong client" to force re-registration),
+* ``delay`` — sleep before proceeding (stragglers; exercises the
+  round watchdog's partial aggregation),
+* ``drop`` — abort the TCP transport with no response (connection
+  reset; exercises the manager's eager-eviction path).
+
+Rules fire a bounded number of ``times`` (default: forever) and record
+every hit, so tests assert both the injected failure and the recovery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import List, Optional
+
+from aiohttp import web
+
+
+@dataclasses.dataclass
+class Rule:
+    match: str                      # substring of the request path
+    action: str                     # "error" | "delay" | "drop"
+    status: int = 503               # for "error"
+    delay_s: float = 0.0            # for "delay"
+    times: Optional[int] = None     # None = unlimited
+    hits: int = 0
+
+    def applies(self, path: str) -> bool:
+        return self.match in path and (self.times is None or self.hits < self.times)
+
+
+class FaultInjector:
+    """Attach to any app (manager or worker) at construction time:
+
+        inj = FaultInjector()
+        app = web.Application(middlewares=[inj.middleware])
+        inj.error("heartbeat", status=503, times=2)
+    """
+
+    def __init__(self) -> None:
+        self.rules: List[Rule] = []
+
+        @web.middleware
+        async def middleware(request: web.Request, handler):
+            for rule in self.rules:
+                if not rule.applies(request.path):
+                    continue
+                rule.hits += 1
+                if rule.action == "error":
+                    return web.json_response(
+                        {"err": "injected fault"}, status=rule.status
+                    )
+                if rule.action == "delay":
+                    await asyncio.sleep(rule.delay_s)
+                elif rule.action == "drop":
+                    if request.transport is not None:
+                        request.transport.abort()
+                    raise ConnectionResetError("injected connection drop")
+            return await handler(request)
+
+        self.middleware = middleware
+
+    # ------------------------------------------------------------------
+    def error(self, match: str, status: int = 503, times: Optional[int] = None) -> Rule:
+        rule = Rule(match=match, action="error", status=status, times=times)
+        self.rules.append(rule)
+        return rule
+
+    def delay(self, match: str, seconds: float, times: Optional[int] = None) -> Rule:
+        rule = Rule(match=match, action="delay", delay_s=seconds, times=times)
+        self.rules.append(rule)
+        return rule
+
+    def drop(self, match: str, times: Optional[int] = None) -> Rule:
+        rule = Rule(match=match, action="drop", times=times)
+        self.rules.append(rule)
+        return rule
+
+    def clear(self) -> None:
+        self.rules.clear()

@@ -1,0 +1,54 @@
+"""JAX profiler hooks (SURVEY §5 "Tracing/profiling: absent" — new).
+
+Thin, always-importable wrappers around ``jax.profiler``:
+
+* :func:`profile_trace` — context manager writing an XLA/TensorBoard
+  trace (HLO timelines, per-op device time) to a directory. Enabled
+  explicitly or via ``BATON_TPU_PROFILE=<dir>``; a no-op otherwise, so
+  call sites can wrap hot paths unconditionally.
+* :func:`annotate` — named region that shows up inside traces.
+* :func:`timed` — wall-clock a function with ``block_until_ready`` on
+  its outputs, so async XLA dispatch doesn't fake instant completion.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager, nullcontext
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+ENV_VAR = "BATON_TPU_PROFILE"
+
+
+@contextmanager
+def profile_trace(log_dir: Optional[str] = None):
+    """Trace the enclosed block to ``log_dir`` (or ``$BATON_TPU_PROFILE``).
+
+    No-op when neither is set — safe to leave in production paths.
+    """
+    log_dir = log_dir or os.environ.get(ENV_VAR)
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named trace region (``jax.profiler.TraceAnnotation``); nullcontext
+    if the profiler lacks it (old jax)."""
+    ta = getattr(jax.profiler, "TraceAnnotation", None)
+    return ta(name) if ta is not None else nullcontext()
+
+
+def timed(fn: Callable, *args: Any, **kwargs: Any) -> Tuple[Any, float]:
+    """Run ``fn`` and return ``(result, seconds)``, blocking on all array
+    outputs so the measurement covers device execution, not just
+    dispatch."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
