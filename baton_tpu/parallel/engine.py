@@ -125,8 +125,7 @@ class FedSim:
 
     # ------------------------------------------------------------------
     # wave kernels: return (Σ w·params, Σ w·losses, Σ w, per-client losses)
-    @partial(jax.jit, static_argnums=(0, 6))
-    def _wave_sums_vmap(self, params, frozen, data, n_samples, rngs, n_epochs):
+    def _wave_sums_raw(self, params, frozen, data, n_samples, rngs, n_epochs):
         anchor = params if self.trainer.regularizer is not None else None
 
         def one_client(d, n, r):
@@ -141,49 +140,52 @@ class FedSim:
         lsum = jnp.tensordot(w, client_losses.astype(jnp.float32), axes=(0, 0))
         return psum, lsum, jnp.sum(w), client_losses
 
-    def _make_wave_sums_sharded(self, n_epochs: int):
+    @partial(jax.jit, static_argnums=(0, 6))
+    def _wave_sums_vmap(self, params, frozen, data, n_samples, rngs, n_epochs):
+        return self._wave_sums_raw(params, frozen, data, n_samples, rngs, n_epochs)
+
+    def _make_wave_sums_sharded(self, n_epochs: int, raw: bool = False):
         # Cache per n_epochs: rebuilding the shard_map closure every round
         # would hand jit a fresh function and force an XLA recompile.
         cache = getattr(self, "_sharded_cache", None)
         if cache is None:
             cache = self._sharded_cache = {}
-        if n_epochs in cache:
-            return cache[n_epochs]
-        mesh = self.mesh
-        trainer = self.trainer
+        if n_epochs not in cache:
+            mesh = self.mesh
+            trainer = self.trainer
 
-        def kernel(params, frozen, data, n_samples, rngs):
-            anchor = params if trainer.regularizer is not None else None
+            def kernel(params, frozen, data, n_samples, rngs):
+                anchor = params if trainer.regularizer is not None else None
 
-            def one_client(d, n, r):
-                p, _, losses = trainer.train(
-                    params, d, n, r, n_epochs, anchor, frozen
+                def one_client(d, n, r):
+                    p, _, losses = trainer.train(
+                        params, d, n, r, n_epochs, anchor, frozen
+                    )
+                    return p, losses
+
+                client_params, client_losses = jax.vmap(one_client)(
+                    data, n_samples, rngs
                 )
-                return p, losses
+                w = n_samples.astype(jnp.float32)
+                local_psum = agg.weighted_tree_sum(client_params, w)
+                psum = jax.lax.psum(local_psum, CLIENT_AXIS)
+                lsum = jax.lax.psum(
+                    jnp.tensordot(w, client_losses.astype(jnp.float32), axes=(0, 0)),
+                    CLIENT_AXIS,
+                )
+                wtot = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
+                return psum, lsum, wtot, client_losses
 
-            client_params, client_losses = jax.vmap(one_client)(
-                data, n_samples, rngs
+            sharded = jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
+                out_specs=(P(), P(), P(), P(CLIENT_AXIS)),
+                check_vma=False,
             )
-            w = n_samples.astype(jnp.float32)
-            local_psum = agg.weighted_tree_sum(client_params, w)
-            psum = jax.lax.psum(local_psum, CLIENT_AXIS)
-            lsum = jax.lax.psum(
-                jnp.tensordot(w, client_losses.astype(jnp.float32), axes=(0, 0)),
-                CLIENT_AXIS,
-            )
-            wtot = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
-            return psum, lsum, wtot, client_losses
-
-        sharded = jax.shard_map(
-            kernel,
-            mesh=mesh,
-            in_specs=(P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
-            out_specs=(P(), P(), P(), P(CLIENT_AXIS)),
-            check_vma=False,
-        )
-        fn = jax.jit(sharded)
-        cache[n_epochs] = fn
-        return fn
+            cache[n_epochs] = (sharded, jax.jit(sharded))
+        sharded, jitted = cache[n_epochs]
+        return sharded if raw else jitted
 
     # ------------------------------------------------------------------
     def _pad_wave(self, data, n_samples, rngs, target: int):
@@ -308,6 +310,55 @@ class FedSim:
         )
 
     # ------------------------------------------------------------------
+    # federated evaluation: sample-weighted mean loss/accuracy over the
+    # client axis — the eval-side analogue of the FedAvg weighting
+    @partial(jax.jit, static_argnums=(0,))
+    def _eval_sums_vmap(self, params, data, n_samples, rngs):
+        def one(d, n, r):
+            losses = self.model.per_example_loss(params, d, r)
+            mask = (jnp.arange(losses.shape[0]) < n).astype(jnp.float32)
+            out = {
+                "loss_sum": jnp.sum(losses.astype(jnp.float32) * mask),
+                "n": mask.sum(),
+            }
+            y = d.get("y")
+            # accuracy only for rank-1 class labels (y [B] matching the
+            # per-example losses); sequence targets (LM: y [B, L]) have
+            # no single-label accuracy and would shape-mismatch the mask
+            if (y is not None and jnp.issubdtype(y.dtype, jnp.integer)
+                    and y.ndim == losses.ndim):
+                logits = self.model.apply(params, d, r)
+                correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+                out["correct_sum"] = jnp.sum(correct * mask)
+            return out
+
+        sums = jax.vmap(one)(data, n_samples, rngs)
+        return jax.tree_util.tree_map(jnp.sum, sums)
+
+    def evaluate_round(
+        self,
+        params: Params,
+        data: Dict[str, jax.Array],
+        n_samples: jax.Array,
+        rng: Optional[jax.Array] = None,
+    ) -> Dict[str, float]:
+        """Evaluate global ``params`` on every client's local data
+        (``[C, capacity, ...]`` layout) and return the example-weighted
+        federation-wide ``{"loss": …, "accuracy": …}``. Under a mesh the
+        client axis is evaluated shard-wise and reduced on host (eval is
+        one forward pass; the collective adds nothing here)."""
+        if rng is None:
+            rng = jax.random.key(0)
+        n_samples = jnp.asarray(n_samples)
+        rngs = jax.random.split(rng, int(n_samples.shape[0]))
+        sums = self._eval_sums_vmap(params, data, n_samples, rngs)
+        denom = max(float(sums["n"]), 1.0)
+        out = {"loss": float(sums["loss_sum"]) / denom, "n": denom}
+        if "correct_sum" in sums:
+            out["accuracy"] = float(sums["correct_sum"]) / denom
+        return out
+
+    # ------------------------------------------------------------------
     def run_rounds(
         self,
         params: Params,
@@ -316,18 +367,37 @@ class FedSim:
         rng: jax.Array,
         n_rounds: int,
         n_epochs: int = 1,
+        checkpointer=None,
+        checkpoint_every: int = 1,
         **kw,
     ):
-        """Convenience loop over rounds; returns (params, loss_history list)."""
+        """Convenience loop over rounds; returns (params, loss_history list).
+
+        With a :class:`baton_tpu.utils.checkpoint.Checkpointer` the loop
+        saves params/server-opt-state/history every ``checkpoint_every``
+        rounds and resumes from the latest step on restart. Per-round
+        rngs come from ``fold_in(rng, round_idx)`` so a resumed run
+        replays the identical randomness it would have had uninterrupted.
+        """
         history = []
         server_opt_state = kw.pop("server_opt_state", None)
-        for i in range(n_rounds):
-            rng, sub = jax.random.split(rng)
+        start = 0
+        if checkpointer is not None:
+            restored = checkpointer.restore(
+                params,
+                server_opt_template=self.init_server_opt_state(params),
+            )
+            if restored is not None:
+                params = restored.params
+                server_opt_state = restored.server_opt_state
+                history = list(restored.meta.get("loss_history", []))
+                start = restored.step
+        for i in range(start, n_rounds):
             res = self.run_round(
                 params,
                 data,
                 n_samples,
-                sub,
+                jax.random.fold_in(rng, i),
                 n_epochs=n_epochs,
                 server_opt_state=server_opt_state,
                 **kw,
@@ -335,7 +405,135 @@ class FedSim:
             params = res.params
             server_opt_state = res.server_opt_state
             history.extend(np.asarray(res.loss_history).tolist())
+            if checkpointer is not None and (i + 1) % checkpoint_every == 0:
+                # history items are already Python floats (np tolist)
+                checkpointer.save(
+                    i + 1,
+                    params,
+                    server_opt_state=server_opt_state,
+                    meta={"loss_history": history},
+                )
         return params, history
+
+
+    # ------------------------------------------------------------------
+    # fused rounds: the whole multi-round federated loop as ONE compiled
+    # XLA program — lax.scan over rounds, lax.scan over waves inside.
+    def _make_rounds_fused(self, n_epochs: int, n_rounds: int):
+        cache = getattr(self, "_fused_cache", None)
+        if cache is None:
+            cache = self._fused_cache = {}
+        key = (n_epochs, n_rounds)
+        if key in cache:
+            return cache[key]
+        if self.mesh is not None:
+            kernel = self._make_wave_sums_sharded(n_epochs, raw=True)
+        else:
+            kernel = partial(self._wave_sums_raw, n_epochs=n_epochs)
+        server_opt = self.server_optimizer
+
+        def run(params, frozen, data_w, n_w, rng, server_opt_state):
+            # data_w leaves [n_waves, wave, cap, ...]; n_w [n_waves, wave]
+            n_waves, wave = n_w.shape
+            zeros = jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, jnp.float32), params
+            )
+
+            def one_round(carry, r):
+                p, sos = carry
+                rkeys = jax.random.split(
+                    jax.random.fold_in(rng, r), n_waves * wave
+                ).reshape(n_waves, wave)
+
+                def wave_body(acc, xs):
+                    d, n, rr = xs
+                    psum, lsum, wtot, _ = kernel(p, frozen, d, n, rr)
+                    return (
+                        agg.tree_add(acc[0], psum),
+                        acc[1] + lsum,
+                        acc[2] + wtot,
+                    ), None
+
+                init = (zeros, jnp.zeros((n_epochs,), jnp.float32),
+                        jnp.float32(0.0))
+                (psum, lsum, wtot), _ = jax.lax.scan(
+                    wave_body, init, (data_w, n_w, rkeys)
+                )
+                denom = jnp.maximum(wtot, 1e-9)
+                aggregate = jax.tree_util.tree_map(
+                    lambda s, ref: (s / denom).astype(ref.dtype), psum, p
+                )
+                if server_opt is not None:
+                    p2, sos = _server_update(server_opt, p, aggregate, sos)
+                else:
+                    p2 = aggregate
+                return (p2, sos), lsum / denom
+
+            (p, sos), losses = jax.lax.scan(
+                one_round, (params, server_opt_state), jnp.arange(n_rounds)
+            )
+            return p, sos, losses  # losses [n_rounds, n_epochs]
+
+        fn = jax.jit(run)
+        cache[key] = fn
+        return fn
+
+    def run_rounds_fused(
+        self,
+        params: Params,
+        data,
+        n_samples,
+        rng: jax.Array,
+        n_rounds: int,
+        n_epochs: int = 1,
+        wave_size: Optional[int] = None,
+        server_opt_state=None,
+    ):
+        """``run_rounds`` as a single XLA dispatch.
+
+        The per-round Python of :meth:`run_round` (slicing, accumulation,
+        the aggregate divide, the server update) all becomes traced code
+        inside one jit: ``lax.scan`` over rounds, ``lax.scan`` over HBM
+        waves within a round. One host→device dispatch and one fetch for
+        the whole training run — on a remote/tunneled TPU this removes
+        every per-round round-trip; on any TPU it lets XLA overlap the
+        round boundary with compute. Identical math to ``run_rounds``
+        (same fold_in round rngs; bitwise-equal when the cohort needs no
+        phantom padding).
+        """
+        params, frozen = self._split(params)
+        n_samples = jnp.asarray(n_samples)
+        c = int(n_samples.shape[0])
+        n_dev = self.mesh.devices.size if self.mesh is not None else 1
+        wave = round_up(wave_size if wave_size is not None else c, n_dev)
+        n_waves = -(-c // wave)
+        c_pad = n_waves * wave
+
+        rngs = jax.random.split(rng, c)  # only shape matters for padding
+        data, n_samples, _ = self._pad_wave(data, n_samples, rngs, c_pad)
+        data_w = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(a).reshape((n_waves, wave) + a.shape[1:]),
+            data,
+        )
+        n_w = n_samples.reshape(n_waves, wave)
+        if self.mesh is not None:
+            shard = NamedSharding(self.mesh, P(None, CLIENT_AXIS))
+            data_w = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, shard), data_w
+            )
+            n_w = jax.device_put(n_w, shard)
+
+        if self.server_optimizer is not None and server_opt_state is None:
+            server_opt_state = self.server_optimizer.init(params)
+
+        fn = self._make_rounds_fused(n_epochs, n_rounds)
+        new_params, server_opt_state, losses = fn(
+            params, frozen, data_w, n_w, rng, server_opt_state
+        )
+        if self.partition is not None:
+            new_params = self.partition.merge(new_params, frozen)
+        history = np.asarray(losses).reshape(-1).tolist()
+        return new_params, history
 
 
 def _server_update(server_optimizer, params, aggregate, opt_state):

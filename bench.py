@@ -24,7 +24,7 @@ N_CLIENTS = 32          # one v4-32 chip's shard of 1024 clients
 SAMPLES_PER_CLIENT = 48  # ~50_000 / 1024
 BATCH_SIZE = 32
 N_EPOCHS = 1
-TIMED_ROUNDS = 5
+TIMED_ROUNDS = 20
 TARGET_ROUNDS_PER_SEC = 10.0
 
 
@@ -50,22 +50,23 @@ def main() -> None:
 
     key = jax.random.key(1)
 
-    def one_round(p, k):
-        res = sim.run_round(p, data, n_samples, k, n_epochs=N_EPOCHS,
-                            collect_client_losses=False)
-        return res.params, res.loss_history
-
-    # warmup (compile); the float() host fetch is the sync point —
-    # block_until_ready does not synchronize on the tunneled TPU platform
-    key, sub = jax.random.split(key)
-    params, warm_loss = one_round(params, sub)
-    float(warm_loss[-1])
+    # The production fast path: all TIMED_ROUNDS rounds compiled into ONE
+    # XLA program (lax.scan over rounds — engine.run_rounds_fused), one
+    # dispatch + one host fetch total. The float() fetch is the sync
+    # point — block_until_ready does not synchronize on the tunneled TPU
+    # platform.
+    params, warm_hist = sim.run_rounds_fused(
+        params, data, n_samples, key, n_rounds=TIMED_ROUNDS,
+        n_epochs=N_EPOCHS,
+    )
+    float(warm_hist[-1])
 
     t0 = time.perf_counter()
-    for _ in range(TIMED_ROUNDS):
-        key, sub = jax.random.split(key)
-        params, loss = one_round(params, sub)
-    final_loss = float(loss[-1])  # host fetch: forces the whole chain
+    params, hist = sim.run_rounds_fused(
+        params, data, n_samples, jax.random.fold_in(key, 1),
+        n_rounds=TIMED_ROUNDS, n_epochs=N_EPOCHS,
+    )
+    final_loss = float(hist[-1])  # host fetch: forces the whole chain
     dt = time.perf_counter() - t0
 
     rounds_per_sec = TIMED_ROUNDS / dt

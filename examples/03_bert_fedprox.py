@@ -1,0 +1,76 @@
+"""BASELINE config 3: BERT federated text-classification fine-tune with
+FedProx.
+
+Non-IID text clients drift apart during multi-epoch local training;
+FedProx adds a proximal term ``mu/2 · ||w − w_global||²`` to each
+client's local objective (a pluggable regularizer on the jitted train
+step — core/regularizers.py), keeping local updates anchored to the
+broadcast round model. AG-News stands in as 4-class sequences of token
+ids; swap ``make_data`` for a real tokenized loader.
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from baton_tpu.core.regularizers import fedprox
+from baton_tpu.models.bert import BertConfig, bert_classifier_model
+from baton_tpu.ops.padding import stack_client_datasets
+from baton_tpu.parallel.engine import FedSim
+
+
+def make_data(rng, cfg, n_clients, n_per_client):
+    """Class-correlated token sequences: each class has a 'topic'
+    distribution over the vocabulary; each client is skewed toward two
+    classes (label heterogeneity, the FedProx setting)."""
+    topics = rng.dirichlet(np.full(cfg.vocab_size, 0.1), size=cfg.n_classes)
+    datasets = []
+    for c in range(n_clients):
+        fav = rng.choice(cfg.n_classes, size=2, replace=False)
+        y = rng.choice(fav, size=n_per_client).astype(np.int32)
+        x = np.stack([
+            rng.choice(cfg.vocab_size, size=cfg.max_len, p=topics[label])
+            for label in y
+        ]).astype(np.int32)
+        datasets.append({"x": x, "y": y})
+    return datasets
+
+
+def run(n_clients=8, n_per_client=24, n_rounds=3, n_epochs=2,
+        batch_size=8, mu=0.1, config=None, seed=0):
+    cfg = config or BertConfig.tiny(n_classes=4)
+    rng = np.random.default_rng(seed)
+    data, n_samples = stack_client_datasets(
+        make_data(rng, cfg, n_clients, n_per_client), batch_size=batch_size
+    )
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    model = bert_classifier_model(cfg)
+    sim = FedSim(model, batch_size=batch_size, learning_rate=5e-3,
+                 regularizer=fedprox(mu=mu) if mu else None)
+    params = sim.init(jax.random.key(seed))
+    params, history = sim.run_rounds(
+        params, data, n_samples, jax.random.key(seed + 1),
+        n_rounds=n_rounds, n_epochs=n_epochs,
+    )
+    metrics = sim.evaluate_round(params, data, n_samples)
+    print(f"FedProx(mu={mu}): loss {history[0]:.4f} -> {history[-1]:.4f}, "
+          f"eval accuracy {metrics['accuracy']:.3f}")
+    return history, metrics
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--scale", choices=["tiny", "full"], default="tiny")
+    p.add_argument("--mu", type=float, default=0.1)
+    args = p.parse_args()
+    if args.scale == "full":
+        run(n_clients=64, n_per_client=1875, n_rounds=30, n_epochs=2,
+            batch_size=32, mu=args.mu,
+            config=BertConfig.base(n_classes=4))  # AG-News: 120k/64
+    else:
+        history, _ = run(mu=args.mu)
+        assert history[-1] < history[0], "loss should fall"

@@ -1,0 +1,125 @@
+"""run_rounds_fused must reproduce run_rounds exactly.
+
+The fused path compiles the whole multi-round loop (scan over rounds,
+scan over waves) into one XLA program; the math is identical, so its
+results must match the per-round Python loop bitwise-modulo-float-assoc
+(same fold_in round rngs, same wave accumulation order).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from baton_tpu.data.synthetic import linear_client_data, synthetic_classification_clients
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.models.mlp import mlp_classifier_model
+from baton_tpu.ops.padding import stack_client_datasets
+from baton_tpu.parallel.engine import FedSim
+from baton_tpu.parallel.mesh import make_mesh
+
+
+def _linear_setup(nprng, n_clients=8):
+    datasets = [linear_client_data(nprng, min_batches=2, max_batches=3)
+                for _ in range(n_clients)]
+    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    return data, jnp.asarray(n_samples)
+
+
+def _assert_trees_close(a, b, rtol=1e-6, atol=1e-6):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=rtol, atol=atol)
+
+
+def test_fused_matches_loop_vmap(nprng):
+    data, n_samples = _linear_setup(nprng)
+    model = linear_regression_model(10)
+    sim = FedSim(model, batch_size=32, learning_rate=0.02)
+    params = sim.init(jax.random.key(0))
+
+    p_loop, h_loop = sim.run_rounds(params, data, n_samples,
+                                    jax.random.key(1), n_rounds=4, n_epochs=2)
+    p_fused, h_fused = sim.run_rounds_fused(params, data, n_samples,
+                                            jax.random.key(1), n_rounds=4,
+                                            n_epochs=2)
+    _assert_trees_close(p_loop, p_fused)
+    np.testing.assert_allclose(h_fused, h_loop, rtol=1e-6)
+
+
+def test_fused_matches_loop_mesh(nprng):
+    data, n_samples = _linear_setup(nprng, n_clients=16)
+    model = linear_regression_model(10)
+    mesh = make_mesh(8)
+    sim = FedSim(model, batch_size=32, learning_rate=0.02, mesh=mesh)
+    params = sim.init(jax.random.key(0))
+
+    p_loop, h_loop = sim.run_rounds(params, data, n_samples,
+                                    jax.random.key(1), n_rounds=3)
+    p_fused, h_fused = sim.run_rounds_fused(params, data, n_samples,
+                                            jax.random.key(1), n_rounds=3)
+    _assert_trees_close(p_loop, p_fused, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h_fused, h_loop, rtol=1e-5)
+
+
+def test_fused_waves_match_single_wave(nprng):
+    # wave accumulation must be associative: 2 waves == 1 wave
+    data, n_samples = _linear_setup(nprng, n_clients=8)
+    model = linear_regression_model(10)
+    sim = FedSim(model, batch_size=32, learning_rate=0.02)
+    params = sim.init(jax.random.key(0))
+    p1, h1 = sim.run_rounds_fused(params, data, n_samples, jax.random.key(1),
+                                  n_rounds=2, wave_size=4)
+    p2, h2 = sim.run_rounds_fused(params, data, n_samples, jax.random.key(1),
+                                  n_rounds=2)
+    _assert_trees_close(p1, p2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h1, h2, rtol=1e-5)
+
+
+def test_fused_phantom_padding(nprng):
+    # 5 clients on an 8-device mesh: 3 phantom clients must not perturb
+    data, n_samples = _linear_setup(nprng, n_clients=5)
+    model = linear_regression_model(10)
+    sim_m = FedSim(model, batch_size=32, learning_rate=0.02, mesh=make_mesh(8))
+    sim_v = FedSim(model, batch_size=32, learning_rate=0.02)
+    params = sim_v.init(jax.random.key(0))
+    p_m, h_m = sim_m.run_rounds_fused(params, data, n_samples,
+                                      jax.random.key(1), n_rounds=2)
+    p_v, h_v = sim_v.run_rounds_fused(params, data, n_samples,
+                                      jax.random.key(1), n_rounds=2)
+    # phantom rng keys differ between the two runs but carry zero weight,
+    # so the aggregates agree
+    _assert_trees_close(p_m, p_v, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h_m, h_v, rtol=1e-5)
+
+
+def test_fused_with_server_optimizer(nprng):
+    data, n_samples = _linear_setup(nprng)
+    model = linear_regression_model(10)
+    kw = dict(batch_size=32, learning_rate=0.02,
+              server_optimizer=optax.adam(0.1))
+    sim = FedSim(model, **kw)
+    params = sim.init(jax.random.key(0))
+    p_loop, h_loop = sim.run_rounds(params, data, n_samples,
+                                    jax.random.key(1), n_rounds=3)
+    p_fused, h_fused = sim.run_rounds_fused(params, data, n_samples,
+                                            jax.random.key(1), n_rounds=3)
+    _assert_trees_close(p_loop, p_fused, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h_fused, h_loop, rtol=1e-5)
+
+
+def test_fused_learns_classification(nprng):
+    datasets, _ = synthetic_classification_clients(nprng, 8)
+    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    model = mlp_classifier_model(32, (64,), 10)
+    sim = FedSim(model, batch_size=32, learning_rate=0.3)
+    params = sim.init(jax.random.key(0))
+    params, history = sim.run_rounds_fused(
+        params, data, jnp.asarray(n_samples), jax.random.key(1),
+        n_rounds=10, n_epochs=2,
+    )
+    assert history[-1] < history[0] * 0.5
+    metrics = sim.evaluate_round(params, data, jnp.asarray(n_samples))
+    assert metrics["accuracy"] > 0.7
