@@ -6,6 +6,12 @@ from baton_tpu.parallel.ring_attention import (
     make_ring_attention_fn,
     make_ulysses_attention_fn,
 )
+from baton_tpu.parallel.multihost import initialize_multihost, make_hybrid_mesh
+from baton_tpu.parallel.tensor_parallel import (
+    shard_params_tp,
+    tp_sharding_tree,
+    transformer_tp_spec,
+)
 
 __all__ = [
     "make_mesh",
@@ -17,4 +23,9 @@ __all__ = [
     "ulysses_attention",
     "make_ring_attention_fn",
     "make_ulysses_attention_fn",
+    "initialize_multihost",
+    "make_hybrid_mesh",
+    "shard_params_tp",
+    "tp_sharding_tree",
+    "transformer_tp_spec",
 ]

@@ -1,0 +1,89 @@
+"""Multi-host meshes: DCN × ICI topology-aware device layout.
+
+The reference's "distributed backend" is aiohttp over the open internet
+(SURVEY §5); the TPU-native equivalent inside a pod is XLA collectives,
+and across pods/hosts it is the same collectives routed over DCN. The
+rule (per the standard TPU scaling recipe): put the axis with the
+LEAST communication volume on DCN (outermost) and bandwidth-hungry
+axes on ICI.
+
+For federated simulation that mapping is natural: the ``clients`` axis
+only communicates once per round (the FedAvg psum of one model-sized
+tree), so it spans hosts over DCN; ``model``/``seq`` axes move
+activations every layer, so they stay inside a host's ICI domain.
+
+Single-process fallbacks keep everything testable on the virtual CPU
+mesh (SURVEY §4d).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join the jax.distributed runtime (no-op when single-process).
+
+    On TPU pods the arguments are auto-detected from the environment;
+    pass them explicitly for manual bring-up. Returns this process's
+    index. Replaces the reference's worker-side ``register_with_manager``
+    bootstrap (worker.py:41-55) for the simulated-cohort scale-out path.
+    """
+    if num_processes is not None and num_processes <= 1:
+        return 0
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        # already initialized (idempotent bring-up)
+        pass
+    return jax.process_index()
+
+
+def make_hybrid_mesh(
+    ici_axes: Sequence[Tuple[str, int]],
+    dcn_axis: str = "clients",
+) -> Mesh:
+    """Mesh with ``dcn_axis`` spanning hosts and ``ici_axes`` spanning
+    each host's chips.
+
+    ``ici_axes`` are (name, size) with sizes multiplying to the
+    per-host device count; the DCN axis size is the process count.
+    Single-process: collapses to an ordinary device mesh with the same
+    axis names (DCN axis = 1 or folded over local devices), so code is
+    portable between the unit-test CPU mesh and a real pod.
+    """
+    n_proc = jax.process_count()
+    local = jax.local_device_count()
+    ici_names = [n for n, _ in ici_axes]
+    ici_sizes = [s for _, s in ici_axes]
+    ici_total = int(np.prod(ici_sizes)) if ici_sizes else 1
+    if local % ici_total:
+        raise ValueError(
+            f"ICI axes {ici_axes} need {ici_total} devices/host but this "
+            f"host has {local}"
+        )
+    dcn_size = n_proc * (local // ici_total)
+    if n_proc > 1:
+        devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=[local // ici_total] + ici_sizes,
+            dcn_mesh_shape=[n_proc] + [1] * len(ici_sizes),
+        )
+        devices = devices.reshape((dcn_size,) + tuple(ici_sizes))
+    else:
+        devices = mesh_utils.create_device_mesh(
+            (dcn_size,) + tuple(ici_sizes)
+        )
+    return Mesh(devices, (dcn_axis, *ici_names))

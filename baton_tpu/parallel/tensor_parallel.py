@@ -1,0 +1,130 @@
+"""Tensor parallelism for the transformer zoo — GSPMD sharding rules.
+
+The reference has no model parallelism of any kind (SURVEY §2: its only
+"parallelism" is data-parallel federated averaging over HTTP); this
+module exists for the BASELINE configs whose models don't fit one chip
+(config 4: Llama-8B-class LoRA federated tuning).
+
+The TPU-idiomatic mechanism is **sharding annotation, not manual
+collectives**: weights get Megatron-style ``PartitionSpec``s over a
+``model`` mesh axis and XLA's GSPMD partitioner inserts the
+all-reduce/all-gather collectives —
+
+* column-parallel (shard the output feature dim): ``wq/wk/wv``,
+  ``w_gate/w_up``, ``w1`` (+ its bias ``b1``), ``lm_head``;
+* row-parallel (shard the input feature dim): ``wo``, ``w_down``,
+  ``w2`` — the matmul's contraction dim, whose partial sums GSPMD
+  reduces exactly where Megatron would place its all-reduce;
+* vocab-sharded embedding table ``tok_emb``; everything else (norms,
+  biases on the model dim, small heads) replicated.
+
+This composes with the federated axes by name: a
+``Mesh(('clients', 'model'))`` runs vmapped per-client LoRA states on
+the ``clients`` axis while the frozen base rides the ``model`` axis —
+the specs below never mention ``clients``, so GSPMD is free to
+partition the client-batched activations over it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from baton_tpu.core.partition import path_str
+
+Params = Any
+
+MODEL_AXIS = "model"
+
+# leaf name -> (sharded_dim_kind); see module docstring for the rationale
+_COLUMN = ("wq", "wk", "wv", "w_gate", "w_up", "w1", "lm_head")
+_ROW = ("wo", "w_down", "w2")
+_COLUMN_BIAS = ("b1",)
+_VOCAB_ROWS = ("tok_emb",)
+
+
+def transformer_tp_spec(path: str, leaf, axis: str = MODEL_AXIS) -> P:
+    """Megatron-style PartitionSpec for one transformer param leaf.
+
+    ``path`` is the slash-joined tree path (core/partition.py:path_str);
+    matching is on the final component, so the rules apply uniformly to
+    Llama (swiglu), BERT/ViT (gelu MLP), and LoRA-wrapped variants
+    (whose adapter leaves end in the same names under ``lora/``).
+    """
+    name = path.rsplit("/", 1)[-1]
+    if leaf.ndim == 2:
+        if name in _COLUMN:
+            return P(None, axis)
+        if name in _ROW:
+            return P(axis, None)
+        if name in _VOCAB_ROWS:
+            return P(axis, None)
+    if leaf.ndim == 1 and name in _COLUMN_BIAS:
+        return P(axis)
+    return P()
+
+
+def _divisible(leaf, spec: P, mesh: Mesh) -> bool:
+    for dim, names in zip(leaf.shape, spec):
+        if names is None:
+            continue
+        if dim % mesh.shape[names]:
+            return False
+    return True
+
+
+def shard_params_tp(
+    params: Params,
+    mesh: Mesh,
+    spec_fn: Callable[[str, Any], P] = transformer_tp_spec,
+    axis: str = MODEL_AXIS,
+) -> Params:
+    """Place a param tree on ``mesh`` with tensor-parallel shardings.
+
+    Any jitted function consuming the result inherits the layout —
+    GSPMD propagates the shardings through the computation and inserts
+    the TP collectives. Leaves whose dims don't divide the axis size
+    fall back to replicated (correct, just not sharded).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        spec = spec_fn(path_str(path), leaf)
+        if spec != P() and not _divisible(leaf, spec, mesh):
+            spec = P()
+        out.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def tp_sharding_tree(
+    params: Params,
+    mesh: Mesh,
+    spec_fn: Callable[[str, Any], P] = transformer_tp_spec,
+) -> Params:
+    """The NamedSharding pytree for ``params`` — usable as jit's
+    ``in_shardings``/``out_shardings`` so updated params KEEP the TP
+    layout across training steps instead of decaying to replicated."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        spec = spec_fn(path_str(path), leaf)
+        if spec != P() and not _divisible(leaf, spec, mesh):
+            spec = P()
+        out.append(NamedSharding(mesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def describe_tp_sharding(params: Params, mesh: Mesh) -> dict:
+    """{path: spec-string} — introspection/debugging helper."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = {}
+    for path, leaf in flat:
+        p = path_str(path)
+        spec = transformer_tp_spec(p, leaf)
+        if spec != P() and not _divisible(leaf, spec, mesh):
+            spec = P()
+        out[p] = str(spec)
+    return out

@@ -1,0 +1,102 @@
+"""Tensor parallelism: GSPMD sharding rules for the transformer zoo.
+
+Correctness oracle: the same jitted loss/grad computed with replicated
+params must equal the one computed with Megatron-style TP-sharded
+params on a ('clients', 'model') mesh — GSPMD inserts the collectives,
+the math must not change.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from baton_tpu.models.llama import LlamaConfig, llama_lm_model
+from baton_tpu.parallel.multihost import make_hybrid_mesh
+from baton_tpu.parallel.tensor_parallel import (
+    describe_tp_sharding,
+    shard_params_tp,
+    tp_sharding_tree,
+    transformer_tp_spec,
+)
+
+
+def test_spec_rules():
+    w2 = jnp.zeros((8, 8))
+    assert transformer_tp_spec("blocks/0/attn/wq", w2) == P(None, "model")
+    assert transformer_tp_spec("blocks/0/attn/wo", w2) == P("model", None)
+    assert transformer_tp_spec("blocks/0/mlp/w_gate", w2) == P(None, "model")
+    assert transformer_tp_spec("blocks/0/mlp/w_down", w2) == P("model", None)
+    assert transformer_tp_spec("tok_emb", w2) == P("model", None)
+    assert transformer_tp_spec("lm_head", w2) == P(None, "model")
+    assert transformer_tp_spec("blocks/0/norm_attn/scale", jnp.zeros(8)) == P()
+    assert transformer_tp_spec("mlp/b1", jnp.zeros(8)) == P("model")
+
+
+def test_hybrid_mesh_single_process():
+    mesh = make_hybrid_mesh([("model", 4)], dcn_axis="clients")
+    assert mesh.shape == {"clients": 2, "model": 4}
+    mesh2 = make_hybrid_mesh([("seq", 8)], dcn_axis="clients")
+    assert mesh2.shape == {"clients": 1, "seq": 8}
+
+
+def test_tp_grads_match_replicated():
+    cfg = LlamaConfig.tiny(max_len=8, n_heads=4, n_kv_heads=2)
+    model = llama_lm_model(cfg)
+    params = model.init(jax.random.key(0))
+    toks = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(4, cfg.max_len)
+    ).astype(np.int32)
+    batch = {"x": jnp.asarray(toks), "y": jnp.asarray(toks)}
+    rng = jax.random.key(1)
+
+    def loss(p, b):
+        return model.per_example_loss(p, b, rng).mean()
+
+    want_l, want_g = jax.jit(jax.value_and_grad(loss))(params, batch)
+
+    mesh = make_hybrid_mesh([("model", 4)], dcn_axis="clients")
+    tp_params = shard_params_tp(params, mesh)
+    # at least the attention/mlp matrices must actually be sharded
+    desc = describe_tp_sharding(params, mesh)
+    assert desc["blocks/0/attn/wq"] == str(P(None, "model"))
+    batch_sharded = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P("clients"))), batch
+    )
+    got_l, got_g = jax.jit(jax.value_and_grad(loss))(tp_params, batch_sharded)
+    np.testing.assert_allclose(float(got_l), float(want_l), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(got_g),
+                    jax.tree_util.tree_leaves(want_g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_tp_sharding_preserved_across_steps():
+    """With out_shardings from tp_sharding_tree, updated params keep the
+    TP layout (no decay to replicated after the first step)."""
+    cfg = LlamaConfig.tiny(max_len=8)
+    model = llama_lm_model(cfg)
+    params = model.init(jax.random.key(0))
+    mesh = make_hybrid_mesh([("model", 4)], dcn_axis="clients")
+    shardings = tp_sharding_tree(params, mesh)
+    tp_params = shard_params_tp(params, mesh)
+    toks = jnp.zeros((2, cfg.max_len), jnp.int32)
+    batch = {"x": toks, "y": toks}
+    rng = jax.random.key(1)
+
+    @jax.jit
+    def step(p, b):
+        g = jax.grad(lambda q: model.per_example_loss(q, b, rng).mean())(p)
+        return jax.tree_util.tree_map(lambda w, d: w - 0.1 * d, p, g)
+
+    step_pinned = jax.jit(step, out_shardings=shardings)
+    new_params = step_pinned(tp_params, batch)
+    wq = new_params["blocks"][0]["attn"]["wq"]
+    assert wq.sharding.spec == P(None, "model")
+
+
+def test_nondivisible_falls_back_to_replicated():
+    mesh = make_hybrid_mesh([("model", 4)], dcn_axis="clients")
+    params = {"attn": {"wq": jnp.zeros((6, 6))}}  # 6 % 4 != 0
+    sharded = shard_params_tp(params, mesh)
+    assert sharded["attn"]["wq"].sharding.spec in (P(), P(None), P(None, None))
