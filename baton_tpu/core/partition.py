@@ -45,10 +45,23 @@ class ParamPartition:
     the same construction site.
     """
 
-    def __init__(self, treedef, mask: Tuple[bool, ...]):
+    def __init__(self, treedef, mask: Tuple[bool, ...],
+                 paths: Tuple[str, ...] = ()):
         self.treedef = treedef
         self.mask = tuple(mask)
         self.n_trainable = sum(self.mask)
+        # original tree paths per leaf (same order as mask) — lets layout
+        # code (e.g. tensor-parallel sharding of the frozen base) recover
+        # leaf identities that the flat split lists erase
+        self.paths = tuple(paths)
+
+    @property
+    def frozen_paths(self) -> Tuple[str, ...]:
+        return tuple(p for p, m in zip(self.paths, self.mask) if not m)
+
+    @property
+    def trainable_paths(self) -> Tuple[str, ...]:
+        return tuple(p for p, m in zip(self.paths, self.mask) if m)
 
     def split(self, params: Params) -> Tuple[List, List]:
         leaves = jax.tree_util.tree_leaves(params)
@@ -84,4 +97,5 @@ def make_partition(params: Params, predicate: PathPredicate) -> ParamPartition:
     mask = tuple(bool(predicate(path_str(p), l)) for p, l in path_leaves)
     if not any(mask):
         raise ValueError("partition selects no trainable leaves")
-    return ParamPartition(treedef, mask)
+    return ParamPartition(treedef, mask,
+                          paths=tuple(path_str(p) for p, _ in path_leaves))

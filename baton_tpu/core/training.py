@@ -95,7 +95,7 @@ class LocalTrainer:
             params, opt_state, data, n_samples, rng, n_epochs, anchor, frozen
         )
 
-    @partial(jax.jit, static_argnums=(0, 6))
+    @partial(jax.jit, static_argnums=(0, 6), donate_argnums=(2,))
     def train_with_opt_state(
         self,
         params: Params,

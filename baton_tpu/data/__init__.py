@@ -3,10 +3,22 @@ from baton_tpu.data.synthetic import (
     synthetic_classification_clients,
 )
 from baton_tpu.data.partition import iid_partition, dirichlet_partition
+from baton_tpu.data.datasets import (
+    ByteTokenizer,
+    DatasetUnavailable,
+    load_ag_news,
+    load_cifar10,
+    load_mnist,
+)
 
 __all__ = [
     "linear_client_data",
     "synthetic_classification_clients",
     "iid_partition",
     "dirichlet_partition",
+    "ByteTokenizer",
+    "DatasetUnavailable",
+    "load_ag_news",
+    "load_cifar10",
+    "load_mnist",
 ]

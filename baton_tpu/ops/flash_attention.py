@@ -135,73 +135,85 @@ def _fwd(q, k, v, bias2d, causal, scale, block_q, block_k, interpret):
 
 
 # ======================================================================
-# backward kernel: grid (B, Hq) — one program per query head, blockwise
-# recompute of p from the saved lse (no O(L²) residuals)
+# backward: the standard two-pass flash-attention backward, blockwise
+# recompute of p from the saved lse (no O(L²) residuals). Pass 1 grids
+# (B, Hq, Lk/block_k, Lq/block_q) and accumulates dk/dv/db over the
+# innermost q axis; pass 2 grids (B, Hq, Lq/block_q, Lk/block_k) and
+# accumulates dq over the innermost kv axis. Only block-sized tiles are
+# ever VMEM-resident, so VMEM is O(block²), independent of L (the r1
+# single-program-per-head version held ~7 full [L, d] buffers).
+# delta = rowsum(do·o) is precomputed outside pallas.
 
 
-def _bwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, do_ref, lse_ref,
-                dq_ref, dk_ref, dv_ref, db_ref,
-                *, scale, causal, block_q, block_k, lq, lk):
-    d = q_ref.shape[-1]
-    nq, nk = lq // block_q, lk // block_k
+def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, b_ref,
+                    dk_ref, dv_ref, db_ref, *, scale, causal,
+                    block_q, block_k):
+    j = pl.program_id(2)
+    i = pl.program_id(3)
 
-    dq_ref[0, 0] = jnp.zeros((lq, d), jnp.float32)
+    @pl.when(i == 0)
+    def _init():
+        dk_ref[0, 0] = jnp.zeros_like(dk_ref[0, 0])
+        dv_ref[0, 0] = jnp.zeros_like(dv_ref[0, 0])
+        db_ref[0, 0] = jnp.zeros_like(db_ref[0, 0])
 
-    def kv_body(j, _):
-        kj = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        bj = b_ref[0, pl.ds(j * block_k, block_k)][None, :]
+    qi = q_ref[0, 0].astype(jnp.float32) * scale               # [bq, D]
+    doi = do_ref[0, 0].astype(jnp.float32)                     # [bq, D]
+    lsei = lse_ref[0, 0][:, None]                              # [bq, 1]
+    delta = delta_ref[0, 0][:, None]                           # [bq, 1]
+    kj = k_ref[0, 0].astype(jnp.float32)                       # [bk, D]
+    vj = v_ref[0, 0].astype(jnp.float32)
+    bj = b_ref[0][None, :]                                     # [1, bk]
+
+    s = jnp.dot(qi, kj.T, preferred_element_type=jnp.float32) + bj
+    if causal:
+        q_pos = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
         k_pos = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lsei)                                      # [bq, bk]
+    dp = jnp.dot(doi, vj.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)                                      # [bq, bk]
+    dv_ref[0, 0] += jnp.dot(p.T, doi, preferred_element_type=jnp.float32)
+    dk_ref[0, 0] += jnp.dot(ds.T, qi, preferred_element_type=jnp.float32)
+    db_ref[0, 0] += ds.sum(axis=0)
 
-        def q_body(i, carry):
-            dkj, dvj, dbj = carry
-            qi = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(
-                jnp.float32
-            ) * scale
-            oi = o_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(
-                jnp.float32
-            )
-            doi = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(
-                jnp.float32
-            )
-            lsei = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
-            delta = (doi * oi).sum(axis=-1, keepdims=True)     # [bq, 1]
 
-            s = jnp.dot(qi, kj.T, preferred_element_type=jnp.float32) + bj
-            if causal:
-                q_pos = i * block_q + lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-            p = jnp.exp(s - lsei)                              # [bq, bk]
-            dvj = dvj + jnp.dot(p.T, doi, preferred_element_type=jnp.float32)
-            dp = jnp.dot(doi, vj.T, preferred_element_type=jnp.float32)
-            ds = p * (dp - delta)                              # [bq, bk]
-            dkj = dkj + jnp.dot(ds.T, qi, preferred_element_type=jnp.float32)
-            dbj = dbj + ds.sum(axis=0)
-            dq_blk = dq_ref[0, 0, pl.ds(i * block_q, block_q), :]
-            dq_ref[0, 0, pl.ds(i * block_q, block_q), :] = (
-                dq_blk
-                + scale * jnp.dot(ds, kj, preferred_element_type=jnp.float32)
-            )
-            return dkj, dvj, dbj
+def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, b_ref,
+                   dq_ref, *, scale, causal, block_q, block_k):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
 
-        dkj, dvj, dbj = lax.fori_loop(
-            0, nq, q_body,
-            (
-                jnp.zeros((block_k, d), jnp.float32),
-                jnp.zeros((block_k, d), jnp.float32),
-                jnp.zeros((block_k,), jnp.float32),
-            ),
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
+
+    qi = q_ref[0, 0].astype(jnp.float32) * scale
+    doi = do_ref[0, 0].astype(jnp.float32)
+    lsei = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    kj = k_ref[0, 0].astype(jnp.float32)
+    vj = v_ref[0, 0].astype(jnp.float32)
+    bj = b_ref[0][None, :]
+
+    s = jnp.dot(qi, kj.T, preferred_element_type=jnp.float32) + bj
+    if causal:
+        q_pos = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
         )
-        dk_ref[0, 0, pl.ds(j * block_k, block_k), :] = dkj
-        dv_ref[0, 0, pl.ds(j * block_k, block_k), :] = dvj
-        db_ref[0, 0, pl.ds(j * block_k, block_k)] = dbj
-        return 0
-
-    lax.fori_loop(0, nk, kv_body, 0)
+        k_pos = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lsei)
+    dp = jnp.dot(doi, vj.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dq_ref[0, 0] += scale * jnp.dot(
+        ds, kj, preferred_element_type=jnp.float32
+    )
 
 
 def _bwd_call(q, k, v, bias2d, out, dout, lse,
@@ -209,38 +221,59 @@ def _bwd_call(q, k, v, bias2d, out, dout, lse,
     b, hq, lq, d = q.shape
     hkv, lk = k.shape[1], k.shape[2]
     group = hq // hkv
-    grid = (b, hq)
+    nq, nk = lq // block_q, lk // block_k
 
-    kernel = functools.partial(
-        _bwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, lq=lq, lk=lk,
+    # delta [B, Hq, Lq] in fp32 — cheap elementwise reduce, let XLA fuse it
+    delta = jnp.sum(
+        dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
-    dq, dk_h, dv_h, db_h = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            _spec((1, 1, lq, d), lambda b_, h: (b_, h, 0, 0)),
-            _spec((1, 1, lk, d), lambda b_, h: (b_, h // group, 0, 0)),
-            _spec((1, 1, lk, d), lambda b_, h: (b_, h // group, 0, 0)),
-            _spec((1, lk), lambda b_, h: (b_, 0)),
-            _spec((1, 1, lq, d), lambda b_, h: (b_, h, 0, 0)),
-            _spec((1, 1, lq, d), lambda b_, h: (b_, h, 0, 0)),
-            _spec((1, 1, lq), lambda b_, h: (b_, h, 0)),
-        ],
+
+    def in_specs(qi, kj):
+        """Common input specs; ``qi``/``kj`` pick the q/kv block index out
+        of the two trailing grid axes (x, y)."""
+        q_spec = _spec((1, 1, block_q, d),
+                       lambda b_, h, x, y: (b_, h, qi(x, y), 0))
+        lse_spec = _spec((1, 1, block_q),
+                         lambda b_, h, x, y: (b_, h, qi(x, y)))
+        kv_spec = _spec((1, 1, block_k, d),
+                        lambda b_, h, x, y: (b_, h // group, kj(x, y), 0))
+        bias_spec = _spec((1, block_k), lambda b_, h, x, y: (b_, kj(x, y)))
+        return [q_spec, q_spec, lse_spec, lse_spec,
+                kv_spec, kv_spec, bias_spec]
+
+    # pass 1: dk/dv/db — grid (…, kv, q), q innermost (accumulated over)
+    dk_h, dv_h, db_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, hq, nk, nq),
+        in_specs=in_specs(qi=lambda x, y: y, kj=lambda x, y: x),
         out_specs=[
-            _spec((1, 1, lq, d), lambda b_, h: (b_, h, 0, 0)),
-            _spec((1, 1, lk, d), lambda b_, h: (b_, h, 0, 0)),
-            _spec((1, 1, lk, d), lambda b_, h: (b_, h, 0, 0)),
-            _spec((1, 1, lk), lambda b_, h: (b_, h, 0)),
+            _spec((1, 1, block_k, d), lambda b_, h, x, y: (b_, h, x, 0)),
+            _spec((1, 1, block_k, d), lambda b_, h, x, y: (b_, h, x, 0)),
+            _spec((1, 1, block_k), lambda b_, h, x, y: (b_, h, x)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hq, lq, d), jnp.float32),
             jax.ShapeDtypeStruct((b, hq, lk, d), jnp.float32),
             jax.ShapeDtypeStruct((b, hq, lk, d), jnp.float32),
             jax.ShapeDtypeStruct((b, hq, lk), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, bias2d, out, dout, lse)
+    )(q, dout, lse, delta, k, v, bias2d)
+
+    # pass 2: dq — grid (…, q, kv), kv innermost (accumulated over)
+    (dq,) = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, hq, nq, nk),
+        in_specs=in_specs(qi=lambda x, y: x, kj=lambda x, y: y),
+        out_specs=[
+            _spec((1, 1, block_q, d), lambda b_, h, x, y: (b_, h, x, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, lq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, dout, lse, delta, k, v, bias2d)
 
     # per-query-head kv grads fold back onto the Hkv axis (GQA)
     dk = dk_h.reshape(b, hkv, group, lk, d).sum(axis=2)
@@ -323,8 +356,18 @@ def flash_attention(
         )
         bias2d = bias.reshape(b, lk).astype(jnp.float32)
 
-    block_q = min(block_q, _round_pow2(lq))
-    block_k = min(block_k, _round_pow2(lk))
+    if interpret:
+        # CPU interpret mode: shrink blocks to the sequence so tiny test
+        # shapes don't pay 128-padding
+        block_q = min(block_q, _round_pow2(lq))
+        block_k = min(block_k, _round_pow2(lk))
+    else:
+        # Real TPU lowering: blocks appear as the minor dim of the lse/db
+        # tiles and the second-minor of the score tile, so keep them
+        # (8, 128)-tile aligned — never below 128. Short sequences are
+        # padded up to one block (padded keys carry -inf bias).
+        block_q = max(128, min(block_q, _round_pow2(lq)))
+        block_k = max(128, min(block_k, _round_pow2(lk)))
     pad_q = (-lq) % block_q
     pad_k = (-lk) % block_k
     if pad_q:

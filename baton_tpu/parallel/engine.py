@@ -44,6 +44,7 @@ from baton_tpu.core.training import LocalTrainer, make_local_trainer, make_evalu
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.ops.padding import round_up
 from baton_tpu.parallel.mesh import CLIENT_AXIS, client_sharding
+from baton_tpu.parallel.tensor_parallel import MODEL_AXIS, shard_params_tp
 
 Params = Any
 
@@ -112,6 +113,53 @@ class FedSim:
             return params, None
         self._ensure_partition(params)
         return self.partition.split(params)
+
+    # ------------------------------------------------------------------
+    @property
+    def is_hybrid(self) -> bool:
+        """True for a ``('clients', 'model')``-style hybrid mesh: the
+        frozen base rides tensor-parallel shardings on the ``model`` axis
+        while per-client work spreads over ``clients`` (BASELINE config 4
+        — a Llama-8B base physically cannot replicate per chip)."""
+        return self.mesh is not None and MODEL_AXIS in self.mesh.axis_names
+
+    def _clients_per_wave_unit(self) -> int:
+        """Wave sizes must be a multiple of the client-axis extent."""
+        if self.mesh is None:
+            return 1
+        if self.is_hybrid:
+            return int(self.mesh.shape[CLIENT_AXIS])
+        return int(self.mesh.devices.size)
+
+    def _place_hybrid(self, params, frozen):
+        """GSPMD placement for the hybrid mesh: trainable globals
+        replicated, frozen base tensor-parallel over ``model``. Data is
+        placed per-wave (client_sharding). XLA's GSPMD partitioner then
+        derives the whole round program — per-client compute partitioned
+        over ``clients``, every frozen-base matmul Megatron-sharded over
+        ``model`` — with no shard_map or manual collectives."""
+        params = jax.device_put(
+            params, NamedSharding(self.mesh, P())
+        )
+        if frozen is not None:
+            # frozen is a flat leaf list (partition.split); shard each
+            # leaf by its ORIGINAL tree path so the Megatron name rules
+            # (wq/wo/w_gate/…) still apply
+            from baton_tpu.parallel.tensor_parallel import (
+                leaf_tp_sharding,
+            )
+
+            paths = self.partition.frozen_paths if self.partition else None
+            if paths and len(paths) == len(frozen):
+                frozen = [
+                    jax.device_put(
+                        leaf, leaf_tp_sharding(path, leaf, self.mesh)
+                    )
+                    for path, leaf in zip(paths, frozen)
+                ]
+            else:
+                frozen = shard_params_tp(frozen, self.mesh)
+        return params, frozen
 
     # ------------------------------------------------------------------
     def init(self, rng: jax.Array) -> Params:
@@ -232,13 +280,21 @@ class FedSim:
         c = int(n_samples.shape[0])
         rngs = jax.random.split(rng, c)
 
-        n_dev = self.mesh.devices.size if self.mesh is not None else 1
+        n_dev = self._clients_per_wave_unit()
         if wave_size is None:
             wave_size = round_up(c, n_dev)
         else:
             wave_size = round_up(wave_size, n_dev)
 
-        if self.mesh is not None:
+        if self.is_hybrid:
+            # hybrid clients×model mesh: plain jit + GSPMD (see
+            # _place_hybrid) — shard_map would force manual TP collectives
+            params, frozen = self._place_hybrid(params, frozen)
+            call = lambda d, n, r: self._wave_sums_vmap(
+                params, frozen, d, n, r, n_epochs
+            )
+            in_shard = client_sharding(self.mesh)
+        elif self.mesh is not None:
             wave_fn = self._make_wave_sums_sharded(n_epochs)
             call = lambda d, n, r: wave_fn(params, frozen, d, n, r)
             in_shard = client_sharding(self.mesh)
@@ -351,7 +407,7 @@ class FedSim:
         n_samples = jnp.asarray(n_samples)
         c = int(n_samples.shape[0])
         rngs = jax.random.split(rng, c)
-        n_dev = self.mesh.devices.size if self.mesh is not None else 1
+        n_dev = self._clients_per_wave_unit()
         wave = round_up(wave_size if wave_size is not None else c, n_dev)
         in_shard = client_sharding(self.mesh) if self.mesh is not None else None
 
@@ -445,16 +501,19 @@ class FedSim:
     # ------------------------------------------------------------------
     # fused rounds: the whole multi-round federated loop as ONE compiled
     # XLA program — lax.scan over rounds, lax.scan over waves inside.
-    def _make_rounds_fused(self, n_epochs: int, n_rounds: int):
+    def _make_rounds_fused(self, n_epochs: int, n_rounds: int,
+                           donate: bool = False):
         cache = getattr(self, "_fused_cache", None)
         if cache is None:
             cache = self._fused_cache = {}
-        key = (n_epochs, n_rounds)
+        key = (n_epochs, n_rounds, donate)
         if key in cache:
             return cache[key]
-        if self.mesh is not None:
+        if self.mesh is not None and not self.is_hybrid:
             kernel = self._make_wave_sums_sharded(n_epochs, raw=True)
         else:
+            # single-device AND hybrid mesh: raw vmap math; on the hybrid
+            # mesh GSPMD partitions it from the input placements
             kernel = partial(self._wave_sums_raw, n_epochs=n_epochs)
         server_opt = self.server_optimizer
 
@@ -500,7 +559,11 @@ class FedSim:
             )
             return p, sos, losses  # losses [n_rounds, n_epochs]
 
-        fn = jax.jit(run)
+        # donate=True aliases the incoming params/server-opt buffers into
+        # the outputs (HBM hygiene: no double-buffered global state across
+        # the dispatch) — opt-in because it invalidates the caller's
+        # arrays on accelerator backends.
+        fn = jax.jit(run, donate_argnums=(0, 5) if donate else ())
         cache[key] = fn
         return fn
 
@@ -515,8 +578,14 @@ class FedSim:
         wave_size: Optional[int] = None,
         server_opt_state=None,
         return_server_opt_state: bool = False,
+        donate_buffers: bool = False,
     ):
         """``run_rounds`` as a single XLA dispatch.
+
+        ``donate_buffers=True`` donates the params/server-opt input
+        buffers to XLA (the returned arrays alias them) — use on the
+        production path when the caller no longer needs the old globals;
+        the inputs become invalid on accelerator backends.
 
         The per-round Python of :meth:`run_round` (slicing, accumulation,
         the aggregate divide, the server update) all becomes traced code
@@ -531,7 +600,7 @@ class FedSim:
         params, frozen = self._split(params)
         n_samples = jnp.asarray(n_samples)
         c = int(n_samples.shape[0])
-        n_dev = self.mesh.devices.size if self.mesh is not None else 1
+        n_dev = self._clients_per_wave_unit()
         wave = round_up(wave_size if wave_size is not None else c, n_dev)
         n_waves = -(-c // wave)
         c_pad = n_waves * wave
@@ -549,11 +618,13 @@ class FedSim:
                 lambda a: jax.device_put(a, shard), data_w
             )
             n_w = jax.device_put(n_w, shard)
+        if self.is_hybrid:
+            params, frozen = self._place_hybrid(params, frozen)
 
         if self.server_optimizer is not None and server_opt_state is None:
             server_opt_state = self.server_optimizer.init(params)
 
-        fn = self._make_rounds_fused(n_epochs, n_rounds)
+        fn = self._make_rounds_fused(n_epochs, n_rounds, donate=donate_buffers)
         new_params, server_opt_state, losses = fn(
             params, frozen, data_w, n_w, rng, server_opt_state
         )

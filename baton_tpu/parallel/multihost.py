@@ -46,9 +46,12 @@ def initialize_multihost(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError:
-        # already initialized (idempotent bring-up)
-        pass
+    except RuntimeError as e:
+        # Idempotent bring-up is fine; anything else (bad coordinator
+        # address, timeout) must surface, not silently degrade to a
+        # single-process run.
+        if "already initialized" not in str(e).lower():
+            raise
     return jax.process_index()
 
 

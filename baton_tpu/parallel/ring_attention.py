@@ -22,9 +22,12 @@ Both are exact (not approximations) and drop into any model in the zoo
 through the ``attention_fn`` seam (:mod:`baton_tpu.models.transformer`)
 via :func:`make_ring_attention_fn` / :func:`make_ulysses_attention_fn`,
 which shard_map the [B, H, L, Dh] tensors over a sequence mesh axis at
-the attention boundary. Padding biases are not supported under sequence
-parallelism (pack or pad-to-block instead); causal masking is computed
-from global positions and is exact.
+the attention boundary. Additive per-key padding biases ([B, 1, 1, L],
+the transformer seam's masking convention) ARE supported: under ring
+the bias is sharded with K/V and rotates around the ring with them;
+under Ulysses it is all-gathered to full length alongside the
+head-resharded K/V. Causal masking is computed from global positions
+and is exact; fully-masked future blocks skip their matmuls entirely.
 """
 
 from __future__ import annotations
@@ -77,17 +80,22 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
     ``m``, normalizer ``l``, accumulator ``o``) is rescaled as each new
     K/V block arrives, so the result is bit-for-bit a softmax over the
     full sequence, never materializing L×L scores.
+
+    ``bias`` is the per-shard additive key bias [B, Lk/N] (fp32; -inf to
+    mask padding keys) — it is sharded exactly like K/V and rides the
+    same ring rotations, so global key positions keep their bias no
+    matter which device currently holds the block.
     """
-    if bias is not None:
-        raise NotImplementedError(
-            "padding bias under ring attention is unsupported; pack "
-            "sequences or pad to the block boundary"
-        )
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, hq, lc, dh = q.shape
+    lk = k.shape[2]
     scale = dh ** -0.5
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if bias is None:
+        bias = jnp.zeros((b, lk), jnp.float32)
+    bias = bias.astype(jnp.float32)
 
     qf = q.astype(jnp.float32)
     # carries start device-invariant but become device-varying inside the
@@ -99,39 +107,52 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
     m = varying(jnp.full((b, hq, lc), _NEG, jnp.float32))
     l = varying(jnp.zeros((b, hq, lc), jnp.float32))
 
-    def accum(s, o, m, l, k_cur, v_cur):
+    def accum(s, o, m, l, k_cur, v_cur, b_cur):
         # after s forward rotations, this device holds the block that
         # originated on device (my - s) mod n
         src = (my - s) % n
-        scores = _block_scores(qf, k_cur.astype(jnp.float32), scale)
-        if causal:
-            q_pos = my * lc + jnp.arange(lc)
-            k_pos = src * lc + jnp.arange(lc)
-            scores = jnp.where(
-                q_pos[:, None] >= k_pos[None, :], scores, _NEG
+
+        def attend(carry):
+            o, m, l = carry
+            scores = _block_scores(qf, k_cur.astype(jnp.float32), scale)
+            scores = scores + b_cur[:, None, None, :]
+            if causal:
+                q_pos = my * lc + jnp.arange(lc)
+                k_pos = src * lc + jnp.arange(lk)
+                scores = jnp.where(
+                    q_pos[:, None] >= k_pos[None, :], scores, _NEG
+                )
+            m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+            p = jnp.exp(scores - m_new[..., None])
+            # fully-masked entries: exp(NEG - NEG) == 1 must be zeroed
+            p = jnp.where(scores > _NEG / 2, p, 0.0)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=-1)
+            o_new = o * corr[..., None] + _block_pv(
+                p, v_cur.astype(jnp.float32), hq
             )
-        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
-        p = jnp.exp(scores - m_new[..., None])
-        # fully-masked entries: exp(NEG - NEG) == 1 must be zeroed
-        p = jnp.where(scores > _NEG / 2, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1)
-        o = o * corr[..., None] + _block_pv(p, v_cur.astype(jnp.float32), hq)
-        return o, m_new, l
+            return o_new, m_new, l_new
+
+        if causal:
+            # a block strictly in this shard's future is fully masked:
+            # skip its two matmuls (≈halves causal ring FLOPs on average)
+            return lax.cond(src <= my, attend, lambda c: c, (o, m, l))
+        return attend((o, m, l))
 
     def step(s, carry):
-        o, m, l, k_cur, v_cur = carry
+        o, m, l, k_cur, v_cur, b_cur = carry
         k_cur = lax.ppermute(k_cur, axis_name, perm)
         v_cur = lax.ppermute(v_cur, axis_name, perm)
-        o, m, l = accum(s, o, m, l, k_cur, v_cur)
-        return o, m, l, k_cur, v_cur
+        b_cur = lax.ppermute(b_cur, axis_name, perm)
+        o, m, l = accum(s, o, m, l, k_cur, v_cur, b_cur)
+        return o, m, l, k_cur, v_cur, b_cur
 
     # step 0 is peeled (local block needs no rotation) and the rotation
     # happens at the top of each remaining step, so exactly n-1 ppermute
     # pairs are issued — a tail rotation whose result is discarded would
     # otherwise waste one neighbor-exchange of full K/V per layer per step
-    o, m, l = accum(0, o, m, l, k, v)
-    o, m, l, _, _ = lax.fori_loop(1, n, step, (o, m, l, k, v))
+    o, m, l = accum(0, o, m, l, k, v, bias)
+    o, m, l, _, _, _ = lax.fori_loop(1, n, step, (o, m, l, k, v, bias))
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
@@ -144,11 +165,6 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     dense kernel, and re-shards back to length. Requires both the query
     and kv head counts to be divisible by the axis size.
     """
-    if bias is not None:
-        raise NotImplementedError(
-            "padding bias under Ulysses attention is unsupported; pack "
-            "sequences or pad to the block boundary"
-        )
     from baton_tpu.models.transformer import dot_product_attention
 
     n = lax.psum(1, axis_name)
@@ -162,32 +178,63 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
+    full_bias = None
+    if bias is not None:
+        # per-shard [B, Lk/N] key bias -> full [B, 1, 1, Lk]: every device
+        # attends over the whole sequence after the head re-shard, so it
+        # needs every key's bias (cheap — bias is [B, L], not [B, L, Dh])
+        full = lax.all_gather(bias.astype(jnp.float32), axis_name,
+                              axis=1, tiled=True)
+        full_bias = full[:, None, None, :]
+
     out = dot_product_attention(
-        to_heads(q), to_heads(k), to_heads(v), causal=causal
+        to_heads(q), to_heads(k), to_heads(v), bias=full_bias, causal=causal
     )
     return to_seq(out)
 
 
-def _seq_sharded_fn(kernel, mesh: Mesh, axis_name: str):
+def _seq_sharded_fn(kernel, mesh: Mesh, axis_name: str, with_bias: bool):
     spec = P(None, None, axis_name, None)
+    bias_spec = P(None, axis_name)  # [B, L] key bias, sharded on L
 
-    @partial(
-        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-    )
-    def sharded(q, k, v):
-        return kernel(q, k, v)
+    if with_bias:
+        @partial(
+            shard_map, mesh=mesh,
+            in_specs=(spec, spec, spec, bias_spec), out_specs=spec,
+        )
+        def sharded(q, k, v, bias2d):
+            return kernel(q, k, v, bias=bias2d)
+    else:
+        @partial(
+            shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        def sharded(q, k, v):
+            return kernel(q, k, v)
 
     return sharded
+
+
+def _check_seam_bias(bias, b, lk):
+    """The transformer seam passes additive key bias as [B, 1, 1, L]
+    (transformer.py contract); flatten to the [B, L] the SP kernels
+    shard."""
+    if bias.shape != (b, 1, 1, lk):
+        raise ValueError(
+            f"sequence-parallel attention supports per-key bias "
+            f"[B, 1, 1, L] only; got {bias.shape}"
+        )
+    return bias.reshape(b, lk)
 
 
 def make_ring_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
     """An ``attention_fn`` for the model zoo: shards [B, H, L, Dh] over
     ``mesh[axis_name]`` on L and runs :func:`ring_attention`. The
-    sequence length must be divisible by the axis size."""
+    sequence length must be divisible by the axis size. Padded (BERT/
+    ViT-style) batches work: the [B, 1, 1, L] key bias is sharded with
+    K/V and rotates around the ring."""
 
     def attention_fn(q, k, v, bias=None, causal=False):
-        if bias is not None:
-            raise NotImplementedError("no padding bias under ring attention")
         n = mesh.shape[axis_name]
         if q.shape[2] % n:
             raise ValueError(
@@ -195,7 +242,11 @@ def make_ring_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
                 f"axis {axis_name!r} size {n}; got L={q.shape[2]}"
             )
         kernel = partial(ring_attention, axis_name=axis_name, causal=causal)
-        return _seq_sharded_fn(kernel, mesh, axis_name)(q, k, v)
+        fn = _seq_sharded_fn(kernel, mesh, axis_name,
+                             with_bias=bias is not None)
+        if bias is None:
+            return fn(q, k, v)
+        return fn(q, k, v, _check_seam_bias(bias, q.shape[0], k.shape[2]))
 
     return attention_fn
 
@@ -203,13 +254,10 @@ def make_ring_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
 def make_ulysses_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
     """An ``attention_fn`` for the model zoo backed by
     :func:`ulysses_attention`. Head counts must be divisible by the
-    axis size."""
+    axis size. Padded batches work: the per-key bias shard is
+    all-gathered next to the head re-shard."""
 
     def attention_fn(q, k, v, bias=None, causal=False):
-        if bias is not None:
-            raise NotImplementedError(
-                "no padding bias under Ulysses attention"
-            )
         n = mesh.shape[axis_name]
         hq, hkv = q.shape[1], k.shape[1]
         if hq % n or hkv % n:
@@ -226,6 +274,10 @@ def make_ulysses_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
             )
         kernel = partial(ulysses_attention, axis_name=axis_name,
                          causal=causal)
-        return _seq_sharded_fn(kernel, mesh, axis_name)(q, k, v)
+        fn = _seq_sharded_fn(kernel, mesh, axis_name,
+                             with_bias=bias is not None)
+        if bias is None:
+            return fn(q, k, v)
+        return fn(q, k, v, _check_seam_bias(bias, q.shape[0], k.shape[2]))
 
     return attention_fn

@@ -76,6 +76,20 @@ def _divisible(leaf, spec: P, mesh: Mesh) -> bool:
     return True
 
 
+def leaf_tp_sharding(
+    path: str,
+    leaf,
+    mesh: Mesh,
+    spec_fn: Callable[[str, Any], P] = transformer_tp_spec,
+) -> NamedSharding:
+    """The TP NamedSharding for a single leaf identified by its tree
+    path (with the replicated fallback for non-divisible dims)."""
+    spec = spec_fn(path, leaf)
+    if spec != P() and not _divisible(leaf, spec, mesh):
+        spec = P()
+    return NamedSharding(mesh, spec)
+
+
 def shard_params_tp(
     params: Params,
     mesh: Mesh,

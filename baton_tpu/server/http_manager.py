@@ -114,6 +114,8 @@ class Experiment:
                     restored.meta.get("loss_history", []),
                 )
         self.allow_pickle = allow_pickle
+        self._checkpoint_task = None
+        self._broadcasting = False
         self.simulator = None  # (FedSim, data, n_samples) triple when attached
         self._sim_args: Optional[dict] = None
         self._sim_task = None
@@ -139,6 +141,9 @@ class Experiment:
             await task.stop()
         if self.__session is not None:
             await self.__session.close()
+        if self._checkpoint_task is not None:
+            await self._checkpoint_task
+            self._checkpoint_task = None
         if self.checkpointer is not None:
             self.checkpointer.close()
 
@@ -274,19 +279,35 @@ class Experiment:
             # Fix of SURVEY §2.9 item 3: abort releases the round.
             self.rounds.abort_round()
             return {}
-        body = wire.encode(
-            params_to_state_dict(self.params),
-            {"update_name": round_name, "n_epoch": n_epoch},
-        )
-        results = await asyncio.gather(
-            *[
-                self._notify_client(cid, body)
-                for cid in list(self.registry.clients)
-            ]
-        )
-        for cid, ok in results:
-            if ok:
-                self.rounds.client_start(cid)
+        state_dict = params_to_state_dict(self.params)
+        meta = {"update_name": round_name, "n_epoch": n_epoch}
+        if self.allow_pickle:
+            # Reference-protocol broadcast (manager.py:77-86): stock
+            # reference workers can only decode pickled state_dicts, so
+            # an allow_pickle experiment speaks pickle in BOTH directions
+            # — uploads were already accepted via wire.decode_any.
+            body = wire.encode_pickle(state_dict, meta)
+            ctype = wire.PICKLE_CONTENT_TYPE
+        else:
+            body = wire.encode(state_dict, meta)
+            ctype = wire.CONTENT_TYPE
+        # Participation is recorded inside _notify_client the moment a
+        # client acks — NOT after the gather. A fast worker can train and
+        # upload before slower notifies finish; recording late would let
+        # its update hit a round that doesn't know it (the reference has
+        # this exact race, manager.py:87-89). _broadcasting additionally
+        # keeps _maybe_finish from ending/aborting the round while acks
+        # are still arriving.
+        self._broadcasting = True
+        try:
+            results = await asyncio.gather(
+                *[
+                    self._notify_client(cid, body, ctype)
+                    for cid in list(self.registry.clients)
+                ]
+            )
+        finally:
+            self._broadcasting = False
 
         if self.simulator is not None:
             self.rounds.client_start("__simulated__")
@@ -294,20 +315,31 @@ class Experiment:
                 self._run_simulated(round_name, n_epoch)
             )
 
-        if not len(self.rounds):
+        if self.rounds.in_progress and not len(self.rounds):
             self.rounds.abort_round()
             return dict(results)
+        # every participant may have reported during the (deferred)
+        # broadcast window — settle the round now
+        self._maybe_finish()
         return dict(results)
 
-    async def _notify_client(self, client_id: str, body: bytes):
+    async def _notify_client(
+        self, client_id: str, body: bytes, content_type: str = wire.CONTENT_TYPE
+    ):
         client = self.registry[client_id]
         url = f"{client.url.rstrip('/')}/round_start?client_id={client_id}&key={client.key}"
         try:
             async with self._session.post(
-                url, data=body, headers={"Content-Type": wire.CONTENT_TYPE}
+                url, data=body, headers={"Content-Type": content_type}
             ) as resp:
                 if resp.status == 200:
-                    return client_id, True
+                    # record participation NOW, before yielding back to
+                    # the loop — this client may upload its update at any
+                    # moment after this ack (see start_round)
+                    if self.rounds.in_progress:
+                        self.rounds.client_start(client_id)
+                        return client_id, True
+                    return client_id, False
                 if resp.status == 404:
                     self.registry.drop(client_id)
                     self.rounds.drop_client(client_id)
@@ -358,6 +390,8 @@ class Experiment:
         self._maybe_finish()
 
     def _maybe_finish(self) -> None:
+        if self._broadcasting:
+            return  # start_round settles the round after the last ack
         if not self.rounds.in_progress:
             return
         if len(self.rounds) == 0:
@@ -401,24 +435,38 @@ class Experiment:
             if den:
                 self.rounds.loss_history.append(num / den)
         if self.checkpointer is not None:
-            # wait=False: end_round runs on the event loop (handle_update
-            # → _maybe_finish → here); a synchronous orbax write would
-            # stall heartbeat handling and can get live clients culled.
-            # Orbax serializes concurrent saves internally and writes
-            # atomically (temp dir + rename); close() drains in-flight
-            # saves on shutdown.
-            with self.metrics.timer("checkpoint_s"):
-                self.checkpointer.save(
-                    self.rounds.n_rounds,
-                    self.params,
-                    meta={
-                        "n_rounds": self.rounds.n_rounds,
-                        "loss_history": [
-                            float(x) for x in self.rounds.loss_history
-                        ],
-                    },
-                    wait=False,
-                )
+            # Even with wait=False, orbax's save() blocks synchronously on
+            # any still-in-flight previous async save — under slow storage
+            # back-to-back rounds would stall the event loop (heartbeats
+            # pause, live clients get culled). Run the whole save call in
+            # a worker thread so the loop never waits on storage; orbax
+            # serializes concurrent saves internally and writes atomically
+            # (temp dir + rename); close() drains in-flight saves.
+            import asyncio
+
+            step = self.rounds.n_rounds
+            meta = {
+                "n_rounds": step,
+                "loss_history": [float(x) for x in self.rounds.loss_history],
+            }
+
+            async def _save(params=self.params):
+                with self.metrics.timer("checkpoint_s"):
+                    await asyncio.to_thread(
+                        self.checkpointer.save, step, params,
+                        meta=meta, wait=False,
+                    )
+
+            try:
+                asyncio.get_running_loop()
+                self._checkpoint_task = asyncio.ensure_future(_save())
+            except RuntimeError:
+                # end_round called outside the event loop (direct unit
+                # tests): save inline, there is no loop to stall
+                with self.metrics.timer("checkpoint_s"):
+                    self.checkpointer.save(
+                        step, self.params, meta=meta, wait=False
+                    )
 
     def round_state(self) -> dict:
         return {

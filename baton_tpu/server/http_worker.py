@@ -127,9 +127,7 @@ class ExperimentWorker:
             # the current task ("Task cannot await on itself") and kill
             # heartbeating permanently. The running loop just continues.
             hb = self._heartbeat_task
-            inside_heartbeat = (
-                hb is not None and hb._task is asyncio.current_task()
-            )
+            inside_heartbeat = hb is not None and hb.is_current_task()
             if not inside_heartbeat:
                 if hb is not None:
                     await hb.stop()

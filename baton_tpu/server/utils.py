@@ -11,8 +11,11 @@ Replaces reference utils.py with deliberate fixes (SURVEY §2.9 decisions):
 * ``RunningMean`` — exact weighted mean. The reference's EpochProgress
   running mean is biased (utils.py:85-88: inputs [4,2,6] → 4.75, true
   mean 4.0). FIXED.
-* ``PeriodicTask`` — asyncio start/stop sleep-loop wrapper (utils.py:42-67),
-  kept for heartbeats/culling, with the first call optionally immediate.
+* ``PeriodicTask`` — periodic scheduling for heartbeats/culling. Same
+  *capability* as reference utils.py:42-67, different mechanism: an
+  ``asyncio.Event``-gated wait loop (stop is a prompt event set, not a
+  task cancellation), optional immediate first tick, and exception
+  logging so one failed tick doesn't silently kill the schedule.
 """
 
 from __future__ import annotations
@@ -71,31 +74,69 @@ class RunningMean:
 
 
 class PeriodicTask:
-    """Run an async callable every ``interval`` seconds until stopped."""
+    """Run an async callable every ``interval`` seconds until stopped.
+
+    Stop is signalled through an :class:`asyncio.Event` rather than task
+    cancellation: a tick in progress finishes cleanly, and ``stop()``
+    returns as soon as the loop observes the event (at worst one
+    ``interval``'s wait, interrupted immediately by the event). A tick
+    that raises is logged and the schedule continues — a transient
+    heartbeat failure must not kill liveness checking.
+    """
 
     def __init__(self, func, interval: float, run_immediately: bool = False):
         self.func = func
         self.interval = interval
         self.run_immediately = run_immediately
-        self.is_started = False
-        self._task = None
+        self._stop = asyncio.Event()
+        self._stop.set()  # not running
+        self._loop_task: asyncio.Task | None = None
+
+    @property
+    def is_started(self) -> bool:
+        return not self._stop.is_set()
+
+    def is_current_task(self) -> bool:
+        """True when called from inside this schedule's own tick — used
+        to avoid await-on-self deadlocks in restart paths."""
+        return (
+            self._loop_task is not None
+            and self._loop_task is asyncio.current_task()
+        )
 
     def start(self) -> "PeriodicTask":
-        if not self.is_started:
-            self.is_started = True
-            self._task = asyncio.ensure_future(self._run())
+        if self._stop.is_set():
+            self._stop = asyncio.Event()
+            self._loop_task = asyncio.get_event_loop().create_task(
+                self._schedule()
+            )
         return self
 
     async def stop(self) -> None:
-        if self.is_started:
-            self.is_started = False
-            self._task.cancel()
+        self._stop.set()
+        if self._loop_task is not None:
             with suppress(asyncio.CancelledError):
-                await self._task
+                await self._loop_task
+            self._loop_task = None
 
-    async def _run(self) -> None:
-        if self.run_immediately and self.is_started:
+    async def _tick(self) -> None:
+        try:
             await self.func()
-        while self.is_started:
-            await asyncio.sleep(self.interval)
-            await self.func()
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # keep the schedule alive
+            print(f"PeriodicTask({getattr(self.func, '__name__', self.func)}): "
+                  f"tick failed: {exc!r}")
+
+    async def _schedule(self) -> None:
+        stop = self._stop
+        if self.run_immediately and not stop.is_set():
+            await self._tick()
+        while not stop.is_set():
+            # wait_for(stop.wait(), interval): either the interval elapses
+            # (TimeoutError -> run a tick) or stop fires (exit promptly)
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=self.interval)
+                return
+            except asyncio.TimeoutError:
+                await self._tick()

@@ -74,6 +74,21 @@ class Checkpointer:
             self._mngr.wait_until_finished()
 
     # ------------------------------------------------------------------
+    def _saved_items(self, step: int) -> set:
+        """Names of the items stored at ``step``."""
+        try:
+            meta = self._mngr.item_metadata(step)
+            return {k for k in meta.keys() if meta[k] is not None}
+        except Exception:
+            # fallback: orbax lays out one subdirectory per item
+            step_dir = os.path.join(self.directory, str(step))
+            if os.path.isdir(step_dir):
+                return {
+                    d for d in os.listdir(step_dir)
+                    if os.path.isdir(os.path.join(step_dir, d))
+                }
+            return set()
+
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
@@ -99,7 +114,12 @@ class Checkpointer:
             "params": ocp.args.StandardRestore(params_template),
             "meta": ocp.args.JsonRestore(),
         }
-        if server_opt_template is not None:
+        if server_opt_template is not None and "server_opt" in self._saved_items(step):
+            # Only request server_opt when the checkpoint actually holds
+            # one — e.g. the HTTP manager's end_round never saves server
+            # optimizer state, and pointing a FedOpt-configured run at
+            # such a checkpoint must fall back to fresh optimizer state,
+            # not raise.
             items["server_opt"] = ocp.args.StandardRestore(server_opt_template)
         restored = self._mngr.restore(step, args=ocp.args.Composite(**items))
         return RestoredState(

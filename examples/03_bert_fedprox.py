@@ -16,9 +16,27 @@ import jax.numpy as jnp
 import numpy as np
 
 from baton_tpu.core.regularizers import fedprox
+from baton_tpu.data.datasets import load_ag_news
+from baton_tpu.data.partition import dirichlet_partition
 from baton_tpu.models.bert import BertConfig, bert_classifier_model
 from baton_tpu.ops.padding import stack_client_datasets
 from baton_tpu.parallel.engine import FedSim
+
+
+def make_ag_news_data(rng, cfg, n_clients, n_per_client, alpha=0.3,
+                      data_dir=None):
+    """Real AG-News (byte-tokenized) when the CSVs are cached, else the
+    labelled synthetic surrogate; Dirichlet label-skew shards either way.
+    Requires ``cfg.vocab_size >= 257`` (byte vocab)."""
+    train, _test, info = load_ag_news(
+        data_dir=data_dir, max_len=cfg.max_len, fallback="synthetic",
+        seed=int(rng.integers(1 << 31)),
+    )
+    print(f"dataset: ag_news (synthetic={info['synthetic']})")
+    n_keep = min(n_clients * n_per_client, len(train["y"]))
+    sel = rng.permutation(len(train["y"]))[:n_keep]
+    return dirichlet_partition({k: v[sel] for k, v in train.items()},
+                               n_clients, rng, alpha=alpha)
 
 
 def make_data(rng, cfg, n_clients, n_per_client):
@@ -39,12 +57,16 @@ def make_data(rng, cfg, n_clients, n_per_client):
 
 
 def run(n_clients=8, n_per_client=24, n_rounds=3, n_epochs=2,
-        batch_size=8, mu=0.1, config=None, seed=0):
+        batch_size=8, mu=0.1, config=None, seed=0,
+        real_data=False, data_dir=None):
     cfg = config or BertConfig.tiny(n_classes=4)
     rng = np.random.default_rng(seed)
-    data, n_samples = stack_client_datasets(
-        make_data(rng, cfg, n_clients, n_per_client), batch_size=batch_size
+    shards = (
+        make_ag_news_data(rng, cfg, n_clients, n_per_client, data_dir=data_dir)
+        if real_data
+        else make_data(rng, cfg, n_clients, n_per_client)
     )
+    data, n_samples = stack_client_datasets(shards, batch_size=batch_size)
     data = {k: jnp.asarray(v) for k, v in data.items()}
     n_samples = jnp.asarray(n_samples)
 
@@ -66,11 +88,16 @@ if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--scale", choices=["tiny", "full"], default="tiny")
     p.add_argument("--mu", type=float, default=0.1)
+    p.add_argument("--data-dir", default=None,
+                   help="directory holding AG-News train.csv/test.csv")
     args = p.parse_args()
     if args.scale == "full":
+        # byte-level vocab (257) needs vocab_size >= 257 on the model
         run(n_clients=64, n_per_client=1875, n_rounds=30, n_epochs=2,
-            batch_size=32, mu=args.mu,
-            config=BertConfig.base(n_classes=4))  # AG-News: 120k/64
+            batch_size=32, mu=args.mu, real_data=True,
+            data_dir=args.data_dir,
+            config=BertConfig.base(n_classes=4, vocab_size=512))  # AG-News: 120k/64
     else:
-        history, _ = run(mu=args.mu)
+        history, _ = run(mu=args.mu, real_data=bool(args.data_dir),
+                         data_dir=args.data_dir)
         assert history[-1] < history[0], "loss should fall"

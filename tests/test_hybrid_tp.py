@@ -1,0 +1,135 @@
+"""FedSim × tensor parallelism on a hybrid ('clients', 'model') mesh.
+
+BASELINE config 4 (Llama-8B LoRA) cannot replicate the frozen base per
+chip; the engine must keep it Megatron-sharded over the ``model`` axis
+through a whole federated round while clients spread over ``clients``
+(VERDICT r1 weakness 3). Oracle: the 1-D client-mesh / no-mesh result —
+identical math, different layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from baton_tpu.models.llama import LlamaConfig, llama_lm_model, llama_lora_target
+from baton_tpu.models.lora import lora_trainable, lora_wrap
+from baton_tpu.ops.padding import stack_client_datasets
+from baton_tpu.parallel.engine import FedSim
+from baton_tpu.parallel.mesh import make_mesh
+
+
+def _hybrid_mesh(n_clients_axis=4, n_model_axis=2):
+    devs = np.asarray(jax.devices()[: n_clients_axis * n_model_axis])
+    return Mesh(devs.reshape(n_clients_axis, n_model_axis),
+                ("clients", "model"))
+
+
+def _tiny_lora_setup(n_clients=8):
+    cfg = LlamaConfig.tiny(max_len=16, d_model=64, n_heads=4, n_kv_heads=2,
+                           d_ff=128)
+    model = lora_wrap(llama_lm_model(cfg), rank=4, target=llama_lora_target)
+    rng = np.random.default_rng(0)
+    datasets = []
+    for _ in range(n_clients):
+        n = int(rng.integers(3, 7))
+        toks = rng.integers(0, cfg.vocab_size, size=(n, cfg.max_len))
+        datasets.append({"x": toks.astype(np.int32),
+                         "y": toks.astype(np.int32)})
+    data, n_samples = stack_client_datasets(datasets, batch_size=4)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    params = model.init(jax.random.key(0))
+    return model, params, data, jnp.asarray(n_samples)
+
+
+def _sharded_axes(x):
+    return {ax for axes in x.sharding.spec if axes is not None
+            for ax in ((axes,) if isinstance(axes, str) else axes)}
+
+
+def test_hybrid_round_matches_1d_mesh():
+    model, params, data, n_samples = _tiny_lora_setup()
+    kw = dict(batch_size=4, learning_rate=0.05, trainable=lora_trainable)
+
+    sim_1d = FedSim(model, mesh=make_mesh(8), **kw)
+    res_1d = sim_1d.run_round(params, data, n_samples, jax.random.key(1),
+                              n_epochs=2)
+
+    sim_h = FedSim(model, mesh=_hybrid_mesh(4, 2), **kw)
+    assert sim_h.is_hybrid and not sim_1d.is_hybrid
+    res_h = sim_h.run_round(params, data, n_samples, jax.random.key(1),
+                            n_epochs=2)
+
+    flat_1d = jax.tree_util.tree_leaves(res_1d.params)
+    flat_h = jax.tree_util.tree_leaves(res_h.params)
+    for a, b in zip(flat_1d, flat_h):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(res_1d.loss_history),
+                               np.asarray(res_h.loss_history),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_hybrid_base_stays_tp_sharded():
+    model, params, data, n_samples = _tiny_lora_setup()
+    sim = FedSim(model, batch_size=4, learning_rate=0.05,
+                 trainable=lora_trainable, mesh=_hybrid_mesh(4, 2))
+    res = sim.run_round(params, data, n_samples, jax.random.key(1),
+                        n_epochs=1)
+
+    # The frozen base in the merged output must still carry the Megatron
+    # layout: wq column-parallel over 'model', wo row-parallel.
+    wq = res.params["base"]["blocks"][0]["attn"]["wq"]
+    wo = res.params["base"]["blocks"][0]["attn"]["wo"]
+    assert _sharded_axes(wq) == {"model"}, wq.sharding
+    assert wq.sharding.spec == P(None, "model"), wq.sharding
+    assert wo.sharding.spec == P("model", None), wo.sharding
+    # and the trainable aggregate must NOT be model-sharded (it is the
+    # global adapter state, replicated like the reference's broadcast)
+    some_adapter = jax.tree_util.tree_leaves(res.params["lora"])[0]
+    assert "model" not in _sharded_axes(some_adapter)
+
+
+def test_hybrid_fused_rounds():
+    model, params, data, n_samples = _tiny_lora_setup()
+    kw = dict(batch_size=4, learning_rate=0.05, trainable=lora_trainable)
+
+    sim_h = FedSim(model, mesh=_hybrid_mesh(4, 2), **kw)
+    p_fused, hist_fused = sim_h.run_rounds_fused(
+        params, data, n_samples, jax.random.key(2), n_rounds=2, n_epochs=1)
+
+    sim_0 = FedSim(model, **kw)
+    p_ref, hist_ref = sim_0.run_rounds(
+        params, data, n_samples, jax.random.key(2), n_rounds=2, n_epochs=1)
+
+    np.testing.assert_allclose(np.asarray(hist_fused), np.asarray(hist_ref),
+                               rtol=2e-5, atol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p_fused),
+                    jax.tree_util.tree_leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_remat_matches_no_remat():
+    cfg = LlamaConfig.tiny(max_len=16)
+    base = llama_lm_model(cfg)
+    base_r = llama_lm_model(cfg, remat=True)
+    params = base.init(jax.random.key(0))
+    rng = np.random.default_rng(1)
+    batch = {
+        "x": jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32),
+        "y": jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32),
+    }
+    key = jax.random.key(2)
+
+    def loss(m):
+        return lambda p: m.per_example_loss(p, batch, key).mean()
+
+    l0, g0 = jax.value_and_grad(loss(base))(params)
+    l1, g1 = jax.value_and_grad(loss(base_r))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
