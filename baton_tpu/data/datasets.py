@@ -80,9 +80,15 @@ def load_cifar10(
 
     if os.path.exists(npz):
         z = np.load(npz)
+
+        def norm(x):
+            x = x.astype(np.float32)
+            # uint8-stored archives hold 0..255; honor the [0,1] contract
+            return x / 255.0 if x.max() > 1.5 else x
+
         return (
-            {"x": z["x_train"].astype(np.float32), "y": z["y_train"].astype(np.int32)},
-            {"x": z["x_test"].astype(np.float32), "y": z["y_test"].astype(np.int32)},
+            {"x": norm(z["x_train"]), "y": z["y_train"].astype(np.int32)},
+            {"x": norm(z["x_test"]), "y": z["y_test"].astype(np.int32)},
             {"name": "cifar10", "synthetic": False, "source": npz},
         )
 

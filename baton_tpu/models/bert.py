@@ -30,7 +30,7 @@ from baton_tpu.core.model import FedModel
 from baton_tpu.models.transformer import (
     AttentionFn,
     dense_init,
-    dot_product_attention,
+    default_attention,
     layer_norm,
     ln_init,
     normal_init,
@@ -68,7 +68,7 @@ class BertConfig:
 def bert_classifier_model(
     config: Optional[BertConfig] = None,
     compute_dtype=jnp.float32,
-    attention_fn: AttentionFn = dot_product_attention,
+    attention_fn: AttentionFn = default_attention,
     name: str = "bert_classifier",
 ) -> FedModel:
     cfg = config or BertConfig.base()

@@ -36,7 +36,7 @@ from baton_tpu.core.model import FedModel
 from baton_tpu.models.transformer import (
     AttentionFn,
     dense_init,
-    dot_product_attention,
+    default_attention,
     mha_apply,
     mha_init,
     normal_init,
@@ -110,7 +110,7 @@ def _block_apply(p, x, cfg: LlamaConfig, rope, attention_fn: AttentionFn):
 def llama_lm_model(
     config: Optional[LlamaConfig] = None,
     compute_dtype=jnp.float32,
-    attention_fn: AttentionFn = dot_product_attention,
+    attention_fn: AttentionFn = default_attention,
     name: str = "llama_lm",
     remat: bool = False,
 ) -> FedModel:

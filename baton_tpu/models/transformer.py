@@ -133,6 +133,32 @@ def dot_product_attention(q, k, v, bias=None, causal=False):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def default_attention(q, k, v, bias=None, causal=False):
+    """Backend-dispatching attention — the model zoo's default kernel.
+
+    On TPU this routes to the Pallas flash-attention kernel
+    (ops/flash_attention.py): O(L·block) memory instead of the dense
+    [B, H, L, L] score tensor, fused softmax, same numerics (fp32
+    softmax, GQA). Everywhere else (CPU tests, interpret mode) it stays
+    on the dense einsum path, which XLA:CPU handles better than the
+    interpreted Pallas kernel.
+
+    The dispatch happens at trace time (``jax.default_backend()`` is
+    ordinary Python), so the jitted program contains exactly one kernel
+    — there is no runtime branch. A ``bias`` that is not the standard
+    per-key [B, 1, 1, L] padding bias falls back to the dense kernel,
+    which accepts anything broadcastable to [B, Hq, L, L].
+    """
+    if jax.default_backend() == "tpu":
+        b, _, _, _ = q.shape
+        lk = k.shape[2]
+        if bias is None or bias.shape == (b, 1, 1, lk):
+            from baton_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, bias=bias, causal=causal)
+    return dot_product_attention(q, k, v, bias=bias, causal=causal)
+
+
 def padding_bias(mask, dtype=jnp.float32):
     """[B, L] 1/0 validity mask -> additive [B, 1, 1, L] attention bias."""
     return ((1.0 - mask.astype(jnp.float32)) * -1e30)[:, None, None, :].astype(dtype)
@@ -159,7 +185,7 @@ def mha_apply(
     bias=None,
     causal: bool = False,
     rope: Optional[tuple] = None,
-    attention_fn: AttentionFn = dot_product_attention,
+    attention_fn: AttentionFn = default_attention,
 ):
     """Multi-head attention over x [B, L, D] -> [B, L, D]."""
     b, l, _ = x.shape
@@ -229,7 +255,7 @@ def prenorm_block_init(key, d_model, n_heads, d_ff):
 
 
 def prenorm_block_apply(p, x, n_heads, bias=None,
-                        attention_fn: AttentionFn = dot_product_attention):
+                        attention_fn: AttentionFn = default_attention):
     x = x + mha_apply(p["attn"], layer_norm(x, p["ln1"]), n_heads,
                       bias=bias, attention_fn=attention_fn)
     return x + gelu_mlp_apply(p["mlp"], layer_norm(x, p["ln2"]))

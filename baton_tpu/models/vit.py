@@ -30,7 +30,7 @@ from baton_tpu.core.model import FedModel
 from baton_tpu.models.transformer import (
     AttentionFn,
     dense_init,
-    dot_product_attention,
+    default_attention,
     layer_norm,
     ln_init,
     normal_init,
@@ -81,7 +81,7 @@ def _patchify(x, patch):
 def vit_model(
     config: Optional[ViTConfig] = None,
     compute_dtype=jnp.float32,
-    attention_fn: AttentionFn = dot_product_attention,
+    attention_fn: AttentionFn = default_attention,
     name: str = "vit",
 ) -> FedModel:
     cfg = config or ViTConfig.b16()

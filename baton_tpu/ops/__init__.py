@@ -11,7 +11,10 @@ from baton_tpu.ops.privacy import (
     clip_by_global_norm,
     dp_fedavg,
     global_norm,
+    poisson_sample,
     rdp_epsilon,
+    sampled_gaussian_rdp,
+    subsampled_rdp_epsilon,
 )
 from baton_tpu.ops.secure_agg import (
     aggregate_masked,
@@ -31,7 +34,10 @@ __all__ = [
     "clip_by_global_norm",
     "dp_fedavg",
     "global_norm",
+    "poisson_sample",
     "rdp_epsilon",
+    "sampled_gaussian_rdp",
+    "subsampled_rdp_epsilon",
     "aggregate_masked",
     "mask_update",
     "net_mask_of",

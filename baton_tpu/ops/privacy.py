@@ -19,15 +19,20 @@ Two granularities, composable:
   Gaussian noise of std ``noise_multiplier * clip_norm / n_clients`` is
   added to the mean — the DP-FedAvg recipe.
 
-Accounting is Rényi-DP for the Gaussian mechanism: each step/round is
-``(α, α/(2σ²))``-RDP; compositions add; conversion to (ε, δ) minimizes
-over orders. No subsampling amplification is claimed (the bound is
-valid — conservative — for sampled cohorts).
+Accounting is Rényi-DP. Without sampling each step/round is
+``(α, α/(2σ²))``-RDP (:func:`rdp_epsilon`); with Poisson subsampling
+(:func:`poisson_sample` drives cohort selection,
+``FedSim.run_round(client_indices=…)`` consumes it) the sampled
+Gaussian mechanism's amplified RDP is computed at integer orders via
+the exact binomial expansion (:func:`sampled_gaussian_rdp`), composed
+additively over steps, and converted with the tight RDP→(ε, δ) bound
+(:func:`subsampled_rdp_epsilon`).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Optional, Sequence
 
 import jax
@@ -218,3 +223,119 @@ def rdp_epsilon(noise_multiplier: float, steps: int, delta: float,
         if a > 1.0
     ]
     return float(min(eps))
+
+
+# ---------------------------------------------------------------------------
+# Poisson subsampling + amplified accounting (sampled Gaussian mechanism)
+
+# Integer Rényi orders: the exact SGM expansion below holds at integer α;
+# the dense low range covers high-privacy regimes, the powers of two reach
+# the tiny-q regimes where the optimum α is large.
+INT_ORDERS = tuple(list(range(2, 33)) + [40, 48, 64, 96, 128, 192, 256, 512])
+
+
+def poisson_sample(rng: np.random.Generator, n: int, q: float) -> np.ndarray:
+    """Poisson sampling: each of ``n`` clients/examples independently
+    joins with probability ``q``. Returns the (possibly empty) sorted
+    index array — feed it to ``FedSim.run_round(client_indices=…)``.
+
+    Host-side by design: cohort selection happens at dispatch time and
+    its *size varies* round to round — exactly what the amplification
+    theorem requires and what a static jit shape cannot express. (The
+    engine pads each wave to the device multiple, so the varying cohort
+    recompiles only when it crosses a wave-size boundary.)
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"sampling rate must be in [0, 1], got {q}")
+    return np.flatnonzero(rng.random(n) < q)
+
+
+def _log_comb(n: int, k: int) -> float:
+    return (math.lgamma(n + 1) - math.lgamma(k + 1)
+            - math.lgamma(n - k + 1))
+
+
+def sampled_gaussian_rdp(
+    q: float, noise_multiplier: float,
+    orders: Sequence[int] = INT_ORDERS,
+) -> np.ndarray:
+    """Per-step RDP of the Poisson-sampled Gaussian mechanism.
+
+    At integer order α the SGM satisfies (α, ε_α)-RDP with
+
+        ε_α = log( Σ_{k=0}^{α} C(α,k) (1−q)^{α−k} q^k ·
+                   exp(k(k−1)/(2σ²)) ) / (α−1)
+
+    (Mironov et al. 2019, "Rényi DP of the Sampled Gaussian Mechanism",
+    Thm. 4/§3.3 — the standard accountant's integer-order path). The sum
+    is evaluated in log space; q=0 gives 0, q=1 recovers the unamplified
+    α/(2σ²) exactly.
+    """
+    if noise_multiplier <= 0:
+        return np.full(len(orders), np.inf)
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"sampling rate must be in [0, 1], got {q}")
+    sigma2 = noise_multiplier ** 2
+    out = []
+    for a in orders:
+        if a != int(a) or a < 2:
+            raise ValueError(f"integer orders >= 2 only, got {a}")
+        a = int(a)
+        if q == 0.0:
+            out.append(0.0)
+            continue
+        log_terms = []
+        for k in range(a + 1):
+            t = k * (k - 1) / (2.0 * sigma2)
+            if q < 1.0:
+                t += (_log_comb(a, k) + (a - k) * math.log1p(-q)
+                      + (k * math.log(q) if k else 0.0))
+            elif k < a:
+                continue  # q == 1: only the k == α term survives
+            log_terms.append(t)
+        m = max(log_terms)
+        log_a = m + math.log(sum(math.exp(t - m) for t in log_terms))
+        out.append(log_a / (a - 1))
+    return np.asarray(out)
+
+
+def rdp_to_epsilon(rdp: Sequence[float], orders: Sequence[int],
+                   delta: float) -> float:
+    """Tight RDP→(ε, δ) conversion, minimized over orders:
+
+        ε = rdp_α + log((α−1)/α) − (log δ + log α)/(α−1)
+
+    (Canonne–Kamath–Steinke 2020 refinement of the classic
+    ``rdp + log(1/δ)/(α−1)`` bound — the conversion production DP-SGD
+    accountants report.)
+    """
+    best = np.inf
+    for r, a in zip(rdp, orders):
+        if not np.isfinite(r):
+            continue
+        eps = (r + math.log1p(-1.0 / a)
+               - (math.log(delta) + math.log(a)) / (a - 1))
+        best = min(best, max(eps, 0.0))
+    return float(best)
+
+
+def subsampled_rdp_epsilon(
+    noise_multiplier: float,
+    steps: int,
+    delta: float,
+    sampling_rate: float,
+    orders: Sequence[int] = INT_ORDERS,
+) -> float:
+    """(ε, δ) spent by ``steps`` Poisson-subsampled Gaussian mechanisms.
+
+    The amplified counterpart of :func:`rdp_epsilon`: with sampling rate
+    q = lot/population (example-level DP-SGD) or cohort/registry
+    (client-level DP-FedAvg), per-step RDP shrinks roughly like q²·α/σ²
+    for small q — orders of magnitude over the unamplified bound.
+    Validated against the canonical MNIST DP-SGD setting (σ=1.1,
+    q=256/60000, 60 epochs, δ=1e-5): the classic conversion reproduces
+    the folklore ε=3.0 to three digits, the tight conversion reports
+    ε≈2.60 (tests/test_privacy.py).
+    """
+    rdp = sampled_gaussian_rdp(sampling_rate, noise_multiplier, orders)
+    return rdp_to_epsilon(rdp * steps, orders, delta)

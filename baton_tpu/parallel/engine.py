@@ -188,6 +188,16 @@ class FedSim:
         lsum = jnp.tensordot(w, client_losses.astype(jnp.float32), axes=(0, 0))
         return psum, lsum, jnp.sum(w), client_losses
 
+    # HBM note on donation: wave INPUTS are deliberately not donated.
+    # `params` is reused by every wave of the round (and as the FedProx
+    # anchor), and the per-wave data/rng slices alias the caller's arrays
+    # when a round fits in one wave (jnp identity slices return the same
+    # buffer), so donating them would invalidate data the caller reuses
+    # across rounds. Donation lives where it is safe and large: the
+    # fused round runner donates params+opt state (run_rounds_fused,
+    # donate_argnums) and LocalTrainer.train_with_opt_state donates the
+    # per-client optimizer state (training.py) — the two buffers that
+    # would otherwise be double-buffered per round.
     @partial(jax.jit, static_argnums=(0, 6))
     def _wave_sums_vmap(self, params, frozen, data, n_samples, rngs, n_epochs):
         return self._wave_sums_raw(params, frozen, data, n_samples, rngs, n_epochs)

@@ -93,16 +93,20 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
     scale = dh ** -0.5
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    if bias is None:
-        bias = jnp.zeros((b, lk), jnp.float32)
-    bias = bias.astype(jnp.float32)
-
-    qf = q.astype(jnp.float32)
     # carries start device-invariant but become device-varying inside the
-    # loop; mark them varying up front so the fori_loop types are stable
+    # loop (ppermute outputs are varying); mark them varying up front so
+    # the fori_loop carry types are stable
     def varying(x):
         return lax.pcast(x, (axis_name,), to="varying")
 
+    if bias is None:
+        # locally-created zeros are invariant; the real bias arrives as a
+        # shard_map input (already varying) — both must match the
+        # ppermuted b_cur in the loop carry
+        bias = varying(jnp.zeros((b, lk), jnp.float32))
+    bias = bias.astype(jnp.float32)
+
+    qf = q.astype(jnp.float32)
     o = varying(jnp.zeros((b, hq, lc, dh), jnp.float32))
     m = varying(jnp.full((b, hq, lc), _NEG, jnp.float32))
     l = varying(jnp.zeros((b, hq, lc), jnp.float32))

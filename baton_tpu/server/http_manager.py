@@ -21,6 +21,14 @@ Differences from the reference (each a recorded fix, SURVEY §2.9):
 * weight upload is BTW1 (no unpickling network bytes) unless
   ``allow_pickle=True`` opts into reference-demo compatibility.
 
+With ``secure_agg=True`` the experiment speaks the secure-aggregation
+protocol (server/secure.py): ``start_round`` first runs DH key agreement
+against each worker's ``POST /{name}/secure_keys``, the broadcast
+carries the cohort's public-key directory, uploads arrive masked
+(uint64 ring elements the server cannot read individually), and
+finalization cancels dropped clients' residual masks via per-pair seed
+reveals (``GET /{worker}/reveal``) before dequantizing the sum.
+
 Aggregation is the engine's weighted tree mean — numerically the
 reference formula ``Σ(w·θ)/Σw`` (manager.py:119-126) — and an attached
 :class:`baton_tpu.parallel.engine.FedSim` can contribute a whole TPU-
@@ -89,7 +97,14 @@ class Experiment:
         checkpoint_dir: Optional[str] = None,
         checkpoint_keep: int = 3,
         metrics: Optional[Metrics] = None,
+        secure_agg: bool = False,
+        secure_scale_bits: int = 16,
     ):
+        if secure_agg and allow_pickle:
+            raise ValueError(
+                "secure_agg is incompatible with allow_pickle: reference-"
+                "protocol pickle workers cannot speak the masking protocol"
+            )
         self.name = name
         self.app = app
         self.model = model
@@ -114,6 +129,12 @@ class Experiment:
                     restored.meta.get("loss_history", []),
                 )
         self.allow_pickle = allow_pickle
+        self.secure_agg = secure_agg
+        self.secure_scale_bits = secure_scale_bits
+        # live secure round: {"round_name", "cohort": [ids], "pks": {id: int}}
+        self._secure_round: Optional[dict] = None
+        self._secure_task = None
+        self._secure_finalizing = False
         self._checkpoint_task = None
         self._broadcasting = False
         self.simulator = None  # (FedSim, data, n_samples) triple when attached
@@ -139,6 +160,9 @@ class Experiment:
     async def _stop_background(self, app=None) -> None:
         for task in self._background:
             await task.stop()
+        if self._secure_task is not None:
+            await self._secure_task
+            self._secure_task = None
         if self.__session is not None:
             await self.__session.close()
         if self._checkpoint_task is not None:
@@ -213,7 +237,10 @@ class Experiment:
         return web.json_response(status)
 
     async def handle_end_round(self, request: web.Request) -> web.Response:
-        self.end_round()
+        if self._secure_round is not None:
+            await self._end_round_secure()
+        else:
+            self.end_round()
         return web.json_response(json_clean(self.round_state()))
 
     async def handle_loss_history(self, request: web.Request) -> web.Response:
@@ -241,16 +268,34 @@ class Experiment:
             # validate at the door: a missing/mis-shaped tensor must be
             # rejected now, not crash aggregation after the round state
             # is consumed (which would discard every client's work)
-            state_dict_to_params(self.params, tensors)
+            if self.secure_agg:
+                self._validate_masked_upload(tensors, meta)
+            else:
+                state_dict_to_params(self.params, tensors)
         except Exception:
             return web.json_response({"err": "Bad Payload"}, status=400)
         round_name = meta.get("update_name")
         if not self.rounds.in_progress or round_name != self.rounds.round_name:
             return web.json_response({"error": "Wrong Update"}, status=410)
+        if self._secure_finalizing:
+            # dropout recovery has started and this client's masks are
+            # being cancelled as dropped — its late upload can no longer
+            # be folded into the sum
+            return web.json_response({"error": "Round Finalizing"}, status=410)
+        if (
+            self._secure_round is not None
+            and client_id not in self._secure_round["pks"]
+        ):
+            # not in this round's cohort: its masks reference a pk
+            # directory nobody else holds (e.g. a straggler from an
+            # aborted attempt that reuses this round name) — folding it
+            # in would add uncancellable mask noise
+            return web.json_response({"error": "Not In Cohort"}, status=410)
         self.rounds.client_end(
             client_id,
             {
                 "state_dict": tensors,
+                "masked": bool(meta.get("secure", False)),
                 "n_samples": float(meta.get("n_samples", 0)),
                 "loss_history": [float(x) for x in meta.get("loss_history", [])],
             },
@@ -264,6 +309,12 @@ class Experiment:
     def attach_simulator(self, sim, data, n_samples, wave_size=None) -> None:
         """Let a TPU-simulated cohort participate in every HTTP round as
         one aggregate client (weight = its total sample count)."""
+        if self.secure_agg:
+            raise ValueError(
+                "a simulated cohort runs inside the aggregator process — "
+                "masking it from the server it lives in is meaningless; "
+                "use plain aggregation for simulation"
+            )
         self.simulator = sim
         self._sim_args = {
             "data": data,
@@ -273,6 +324,7 @@ class Experiment:
 
     async def start_round(self, n_epoch: int) -> Dict[str, bool]:
         round_name = self.rounds.start_round(n_epoch=n_epoch)
+        self._secure_round = None  # invalidate any stale secure state
         for cid in self.registry.cull():
             self.rounds.drop_client(cid)
         if not len(self.registry) and self.simulator is None:
@@ -281,6 +333,40 @@ class Experiment:
             return {}
         state_dict = params_to_state_dict(self.params)
         meta = {"update_name": round_name, "n_epoch": n_epoch}
+        if self.secure_agg:
+            # Phase 1 (server/secure.py): per-round DH key agreement.
+            # Clients that fail key exchange are excluded from the cohort
+            # BEFORE the pk directory is broadcast, so every mask a
+            # client adds corresponds to a cohort member the server
+            # knows about (and can run dropout recovery against).
+            pk_results = await asyncio.gather(
+                *[
+                    self._collect_pk(cid, round_name)
+                    for cid in list(self.registry.clients)
+                ]
+            )
+            pks = {cid: pk for cid, pk in pk_results if pk is not None}
+            if not pks:
+                self.rounds.abort_round()
+                return {}
+            self._secure_round = {
+                "round_name": round_name,
+                "cohort": sorted(pks),
+                "pks": pks,
+                "scale_bits": self.secure_scale_bits,
+                # validation template cached once per round: per-upload
+                # params_to_state_dict would device-to-host copy the full
+                # model C times per round just to read names/shapes
+                "template_shapes": {
+                    k: tuple(v.shape)
+                    for k, v in params_to_state_dict(self.params).items()
+                },
+            }
+            meta["secure"] = {
+                "cohort": sorted(pks),
+                "pks": {cid: f"{pk:x}" for cid, pk in pks.items()},
+                "scale_bits": self.secure_scale_bits,
+            }
         if self.allow_pickle:
             # Reference-protocol broadcast (manager.py:77-86): stock
             # reference workers can only decode pickled state_dicts, so
@@ -298,13 +384,15 @@ class Experiment:
         # this exact race, manager.py:87-89). _broadcasting additionally
         # keeps _maybe_finish from ending/aborting the round while acks
         # are still arriving.
+        recipients = (
+            self._secure_round["cohort"]
+            if self._secure_round is not None
+            else list(self.registry.clients)
+        )
         self._broadcasting = True
         try:
             results = await asyncio.gather(
-                *[
-                    self._notify_client(cid, body, ctype)
-                    for cid in list(self.registry.clients)
-                ]
+                *[self._notify_client(cid, body, ctype) for cid in recipients]
             )
         finally:
             self._broadcasting = False
@@ -317,11 +405,61 @@ class Experiment:
 
         if self.rounds.in_progress and not len(self.rounds):
             self.rounds.abort_round()
+            self._secure_round = None
             return dict(results)
         # every participant may have reported during the (deferred)
         # broadcast window — settle the round now
         self._maybe_finish()
         return dict(results)
+
+    async def _collect_pk(self, client_id: str, round_name: str):
+        """Secure-round key agreement with one client; eager eviction on
+        failure mirrors _notify_client (a client that can't answer key
+        exchange won't answer the broadcast either)."""
+        try:
+            client = self.registry[client_id]
+        except UnknownClient:
+            return client_id, None  # culled between snapshot and task run
+        url = (
+            f"{client.url.rstrip('/')}/secure_keys"
+            f"?client_id={client_id}&key={client.key}"
+        )
+        try:
+            async with self._session.post(
+                url, json={"round": round_name}
+            ) as resp:
+                if resp.status == 200:
+                    data = await resp.json()
+                    return client_id, int(data["pk"], 16)
+                if resp.status == 404:
+                    self.registry.drop(client_id)
+                # 409 (worker mid-round) etc.: alive but unavailable this
+                # round — excluded from the cohort, kept registered
+        except (aiohttp.ClientError, ValueError, KeyError):
+            self.registry.drop(client_id)
+        return client_id, None
+
+    async def _request_reveal(
+        self, client_id: str, round_name: str, dropped_id: str
+    ) -> Optional[bytes]:
+        """Ask a reporter for its pairwise seed with a dropped client."""
+        try:
+            client = self.registry[client_id]
+        except UnknownClient:
+            return None
+        url = (
+            f"{client.url.rstrip('/')}/reveal"
+            f"?client_id={client_id}&key={client.key}"
+            f"&round={round_name}&dropped={dropped_id}"
+        )
+        try:
+            async with self._session.get(url) as resp:
+                if resp.status != 200:
+                    return None
+                data = await resp.json()
+                return bytes.fromhex(data["seed"])
+        except (aiohttp.ClientError, ValueError, KeyError):
+            return None
 
     async def _notify_client(
         self, client_id: str, body: bytes, content_type: str = wire.CONTENT_TYPE
@@ -389,6 +527,29 @@ class Experiment:
         )
         self._maybe_finish()
 
+    def _validate_masked_upload(self, tensors, meta) -> None:
+        """A secure-round upload must be EXACTLY the masked uint64 image
+        of the full state dict — a missing, extra, mis-typed, or
+        mis-shaped tensor would poison the modular sum (or crash
+        finalization after the round state is consumed)."""
+        if not meta.get("secure"):
+            raise ValueError("plain upload in a secure-aggregation round")
+        sr = self._secure_round
+        if sr is None:
+            raise ValueError("no secure round in flight")
+        shapes = sr["template_shapes"]
+        extra = set(tensors) - set(shapes)
+        if extra:
+            raise ValueError(f"masked upload has surplus tensors {sorted(extra)}")
+        for name, ref_shape in shapes.items():
+            arr = tensors.get(name)
+            if arr is None:
+                raise KeyError(f"masked upload missing tensor {name!r}")
+            if np.asarray(arr).dtype != np.uint64:
+                raise ValueError(f"masked tensor {name!r} must be uint64")
+            if tuple(np.shape(arr)) != ref_shape:
+                raise ValueError(f"masked tensor {name!r} has wrong shape")
+
     def _maybe_finish(self) -> None:
         if self._broadcasting:
             return  # start_round settles the round after the last ack
@@ -399,13 +560,27 @@ class Experiment:
             # round instead of leaving it locked forever (423 on all
             # future start_round calls — the §2.9 item 3 failure class)
             self.rounds.abort_round()
+            self._secure_round = None
         elif self.rounds.clients_left == 0:
             self.end_round()
 
     def end_round(self) -> None:
         """Aggregate reported weights into the global params — the
-        reference FedAvg step (manager.py:113-132) as one XLA call."""
+        reference FedAvg step (manager.py:113-132) as one XLA call.
+
+        Secure rounds are finalized asynchronously (dropout recovery
+        needs HTTP round-trips): this schedules :meth:`_end_round_secure`
+        on the running loop, or runs it to completion when called from
+        synchronous (test) code."""
         if not self.rounds.in_progress:
+            return
+        if self._secure_round is not None:
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                asyncio.run(self._end_round_secure())
+            else:
+                self._secure_task = loop.create_task(self._end_round_secure())
             return
         n_epoch = (self.rounds.round_meta or {}).get("n_epoch", 0)
         self.metrics.observe("round_s", self.rounds.elapsed)
@@ -422,6 +597,107 @@ class Experiment:
         }
         merged = agg.weighted_tree_mean(stacked, weights)
         self.params = state_dict_to_params(self.params, {k: np.asarray(v) for k, v in merged.items()})
+        self._record_history_and_checkpoint(reports, n_epoch)
+
+    async def _end_round_secure(self) -> None:
+        """Secure-round finalization (server/secure.py step 3).
+
+        The manager can only use the cohort's modular sum: it adds the
+        masked uint64 uploads, cancels residual masks toward cohort
+        members that never reported (each reporter reveals only its
+        pairwise seed with the dropped client), dequantizes, and divides
+        by the reporters' total sample count. If a reporter disappears
+        during recovery the round is unrecoverable — it aborts and the
+        previous global params stand.
+        """
+        from baton_tpu.server import secure
+
+        sr = self._secure_round
+        if (
+            sr is None
+            or not self.rounds.in_progress
+            or self.rounds.round_name != sr["round_name"]
+        ):
+            return
+        if self._secure_finalizing:
+            # a finalization is already past this guard and mid-reveal;
+            # a second one (watchdog tick / explicit end_round during the
+            # await window) must not consume the round out from under it
+            return
+        self._secure_finalizing = True
+        try:
+            # a masked upload is a reporter regardless of n_samples: its
+            # masks are IN the modular sum, so it must not also be
+            # treated as dropped (the correction would double-count);
+            # zero-weight reporters contribute exactly 0 to the mean
+            reporters = {
+                cid: r
+                for cid, r in self.rounds.client_responses.items()
+                if r.get("masked")
+            }
+            dropped = [c for c in sr["cohort"] if c not in reporters]
+            if not reporters:
+                self.rounds.abort_round()
+                self._secure_round = None
+                return
+            template = params_to_state_dict(self.params)
+            corrections = []
+            if dropped:
+                # one flat gather over every (dropped, reporter) pair —
+                # finalization latency is one reveal round-trip, not D
+                rids = list(reporters)
+                pairs = [(d, rid) for d in dropped for rid in rids]
+                seeds = await asyncio.gather(
+                    *[
+                        self._request_reveal(rid, sr["round_name"], d)
+                        for d, rid in pairs
+                    ]
+                )
+                if any(s is None for s in seeds):
+                    # a reporter died mid-recovery: masks toward it can
+                    # no longer be cancelled — the sum is unusable
+                    self.metrics.inc("secure_rounds_unrecoverable")
+                    self.rounds.abort_round()
+                    self._secure_round = None
+                    return
+                by_dropped: Dict[str, dict] = {d: {} for d in dropped}
+                for (d, rid), s in zip(pairs, seeds):
+                    by_dropped[d][rid] = s
+                corrections = [
+                    secure.dropout_correction(d, by_dropped[d], template)
+                    for d in dropped
+                ]
+            if not self.rounds.in_progress or self.rounds.round_name != sr["round_name"]:
+                return  # round was aborted while reveals were in flight
+            if dropped:
+                self.metrics.inc("secure_dropouts_recovered", len(dropped))
+            n_epoch = (self.rounds.round_meta or {}).get("n_epoch", 0)
+            self.metrics.observe("round_s", self.rounds.elapsed)
+            self.rounds.end_round()
+            self.metrics.inc("rounds_finished")
+            # Aggregate the SNAPSHOTTED reporter set, not whatever landed
+            # in the round state since: a straggler in `dropped` that
+            # uploaded during the reveal await window would otherwise be
+            # counted in the sum while its masks are also 'corrected' —
+            # leaving uncancelled mask noise in the params. (handle_update
+            # additionally 410s those stragglers; this is the backstop.)
+            reports = list(reporters.values())
+            masked_sum = secure.modular_sum(
+                [r["state_dict"] for r in reports]
+            )
+            total = secure.unmask_sum(
+                masked_sum, corrections, sr["scale_bits"]
+            )
+            w = sum(float(r["n_samples"]) for r in reports)
+            if w > 0:
+                merged = {k: v / w for k, v in total.items()}
+                self.params = state_dict_to_params(self.params, merged)
+                self._record_history_and_checkpoint(reports, n_epoch)
+            self._secure_round = None
+        finally:
+            self._secure_finalizing = False
+
+    def _record_history_and_checkpoint(self, reports, n_epoch) -> None:
         # loss history: sample-weighted per-epoch mean (manager.py:127-130)
         for epoch in range(n_epoch):
             num = sum(

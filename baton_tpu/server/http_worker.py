@@ -84,7 +84,23 @@ class ExperimentWorker:
         self._register_lock = asyncio.Lock()
         self.__session: Optional[aiohttp.ClientSession] = None
 
+        # secure aggregation (server/secure.py): per-round DH state.
+        # {round_name: sk}; bounded to the two most recent rounds so a
+        # long-lived worker doesn't accumulate keys.
+        self._secure_sk: dict = {}
+        # {round_name: {"cohort": [...], "pks": {cid: int}, "scale_bits": n}}
+        self._secure_ctx: dict = {}
+        # reveal budget: refuse to treat more than this fraction of the
+        # cohort as "dropped" in one round — bounds how many clients a
+        # protocol-deviating manager could unmask via fake dropout claims
+        # (see secure.py threat model; full Bonawitz double-masking is
+        # the complete fix)
+        self.max_reveal_fraction = 1 / 3
+        self._revealed: dict = {}  # {round_name: set(dropped ids revealed)}
+
         app.router.add_post(f"/{self.name}/round_start", self.handle_round_start)
+        app.router.add_post(f"/{self.name}/secure_keys", self.handle_secure_keys)
+        app.router.add_get(f"/{self.name}/reveal", self.handle_reveal)
         if auto_register:
             app.on_startup.append(self._on_startup)
             app.on_cleanup.append(self._on_cleanup)
@@ -153,6 +169,67 @@ class ExperimentWorker:
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, MAX_BACKOFF)
 
+    # -- secure aggregation --------------------------------------------
+    def _check_manager_auth(self, request: web.Request) -> bool:
+        return (
+            request.query.get("client_id") == self.client_id
+            and request.query.get("key") == self.key
+        )
+
+    async def handle_secure_keys(self, request: web.Request) -> web.Response:
+        """Round-setup key agreement: generate a fresh DH keypair for the
+        named round and return the public key (server/secure.py step 1)."""
+        if not self._check_manager_auth(request):
+            return web.json_response({"err": "Wrong Client"}, status=404)
+        if self.round_in_progress:
+            # Mid-round key exchange would rotate the sk a still-running
+            # round's upload will be masked with (aborted rounds REUSE
+            # round names — reference naming parity), producing masks no
+            # peer cancels. Refuse; the manager excludes us this round.
+            return web.json_response({"err": "Update in Progress"}, status=409)
+        from baton_tpu.server import secure
+
+        data = await request.json()
+        round_name = str(data["round"])
+        sk, pk = secure.dh_keypair()
+        self._secure_sk[round_name] = sk
+        while len(self._secure_sk) > 2:  # keep current + previous round
+            self._secure_sk.pop(next(iter(self._secure_sk)))
+        while len(self._secure_ctx) > 2:
+            self._secure_ctx.pop(next(iter(self._secure_ctx)))
+        return web.json_response({"pk": f"{pk:x}"})
+
+    async def handle_reveal(self, request: web.Request) -> web.Response:
+        """Dropout recovery: reveal this worker's pairwise seed with ONE
+        dropped cohort member (never a secret key, never a seed with a
+        live reporter — the manager only learns what it needs to cancel
+        the dropped client's residual masks)."""
+        if not self._check_manager_auth(request):
+            return web.json_response({"err": "Wrong Client"}, status=404)
+        from baton_tpu.server import secure
+
+        round_name = request.query.get("round", "")
+        dropped = request.query.get("dropped", "")
+        sk = self._secure_sk.get(round_name)
+        ctx = self._secure_ctx.get(round_name)
+        if sk is None or ctx is None:
+            return web.json_response({"err": "Unknown Round"}, status=410)
+        pk = ctx["pks"].get(dropped)
+        if pk is None or dropped == self.client_id:
+            return web.json_response({"err": "Unknown Client"}, status=400)
+        revealed = self._revealed.setdefault(round_name, set())
+        budget = max(1, int(len(ctx["cohort"]) * self.max_reveal_fraction))
+        if dropped not in revealed and len(revealed) >= budget:
+            # a manager claiming this many dropouts is either facing a
+            # catastrophic cohort failure or fabricating dropout claims
+            # to unmask clients — either way, refuse (the round aborts)
+            return web.json_response({"err": "Reveal Budget"}, status=429)
+        revealed.add(dropped)
+        while len(self._revealed) > 2:
+            self._revealed.pop(next(iter(self._revealed)))
+        seed = secure.dh_shared_seed(sk, pk, round_name)
+        return web.json_response({"seed": seed.hex()})
+
     # -- rounds --------------------------------------------------------
     async def handle_round_start(self, request: web.Request) -> web.Response:
         if self.round_in_progress:
@@ -175,6 +252,18 @@ class ExperimentWorker:
             # reject before mutating any state: a bad broadcast must not
             # leave the worker with half-loaded params
             return web.json_response({"err": "Bad Payload"}, status=400)
+        secure_info = meta.get("secure")
+        if secure_info is not None:
+            if round_name not in self._secure_sk:
+                # key agreement never happened for this round: we cannot
+                # produce a correctly-masked upload, and an unmasked one
+                # would poison the cohort's modular sum
+                return web.json_response({"err": "No Round Keys"}, status=400)
+            self._secure_ctx[round_name] = {
+                "cohort": list(secure_info["cohort"]),
+                "pks": {c: int(p, 16) for c, p in secure_info["pks"].items()},
+                "scale_bits": int(secure_info.get("scale_bits", 16)),
+            }
         self.params = new_params
         self.last_update = round_name
         self.round_in_progress = True
@@ -212,14 +301,37 @@ class ExperimentWorker:
             self.manager_url
             + f"update?client_id={self.client_id}&key={self.key}"
         )
-        body = wire.encode(
-            params_to_state_dict(self.params),
-            {
-                "update_name": round_name,
-                "n_samples": int(n_samples),
-                "loss_history": [float(x) for x in loss_history],
-            },
-        )
+        meta = {
+            "update_name": round_name,
+            "n_samples": int(n_samples),
+            "loss_history": [float(x) for x in loss_history],
+        }
+        ctx = self._secure_ctx.get(round_name)
+        if ctx is not None:
+            # Secure round: upload sample-weighted quantized params plus
+            # every pairwise mask — the manager can only use the cohort
+            # sum (server/secure.py step 2). Weighting happens client-
+            # side because the server cannot scale a masked ring element.
+            from baton_tpu.server import secure
+
+            sk = self._secure_sk[round_name]
+            seeds = {
+                other: secure.dh_shared_seed(sk, pk, round_name)
+                for other, pk in ctx["pks"].items()
+                if other != self.client_id
+            }
+            weighted = {
+                k: np.asarray(v, np.float64) * float(n_samples)
+                for k, v in params_to_state_dict(self.params).items()
+            }
+            body = wire.encode(
+                secure.mask_state_dict(
+                    weighted, self.client_id, seeds, ctx["scale_bits"]
+                ),
+                dict(meta, secure=True, scale_bits=ctx["scale_bits"]),
+            )
+        else:
+            body = wire.encode(params_to_state_dict(self.params), meta)
         try:
             async with self._session.post(
                 url, data=body, headers={"Content-Type": wire.CONTENT_TYPE}

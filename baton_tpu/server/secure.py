@@ -1,0 +1,233 @@
+"""Secure-aggregation wire protocol: key agreement, masking, recovery.
+
+The reference manager observes every client's raw weights
+(reference manager.py:95-126). This module gives the HTTP control plane
+a Bonawitz-style protocol on top of the modular-masking primitives in
+:mod:`baton_tpu.ops.secure_agg`, so the manager only ever learns the
+*sum* of client updates:
+
+1. **Key agreement** — per round, every cohort member generates a
+   Diffie-Hellman keypair (RFC 3526 group 14, 2048-bit MODP) and sends
+   the public key to the manager (``POST /{name}/secure_keys``); the
+   manager broadcasts the cohort's public-key directory inside
+   ``round_start``. Each pair (i, j) then shares a seed
+   ``SHA-256(round_name ‖ DH(sk_i, pk_j))`` that the server cannot
+   compute.
+2. **Masked upload** — each client quantizes its sample-weighted update
+   into Z_2^64 (fixed point) and adds one Philox-derived uint64 mask
+   per pair: ``+mask`` when its client_id sorts before the peer's,
+   ``−mask`` otherwise. Any single upload is uniform noise to the
+   server; the modular sum over the full cohort is exactly the sum of
+   the quantized updates. The 64-bit ring (vs the 32-bit offline
+   primitive in ops/secure_agg.py) buys headroom for *sample-weighted*
+   sums: at 16 fractional bits, Σᵢ nᵢ·|θ| may reach 2^47 before
+   wrapping — ample for any real federation, where 2^15 (the 32-bit
+   budget) is overflowed by a single 40k-sample client.
+3. **Dropout recovery** — if cohort members vanish between key exchange
+   and upload, every reporter's upload still carries uncancelled masks
+   toward them. The manager asks each reporter to reveal its *pairwise
+   seed with the dropped client only* (``GET /{name}/reveal``), rebuilds
+   those masks, and cancels the residue. Reporters' own pairwise seeds
+   (and all secret keys) never leave the clients.
+
+Threat model — stated precisely, because it is narrower than full
+Bonawitz: the server is **honest-but-curious and follows the protocol**
+(it only requests reveals for clients that genuinely never reported),
+and clients do not collude with it. Under that model the server learns
+only the cohort sum. A server that *deviates* by falsely claiming a
+live reporter dropped can collect the other reporters' seeds toward it
+and unmask that one client's update; closing that hole requires the
+full protocol's double masking (per-client self-mask b_i) with Shamir
+shares so each peer reveals, per client, EITHER the pairwise seed OR
+the self-mask share — never both. Workers bound the damage of a
+deviating server with a per-round reveal budget
+(``max_reveal_fraction``): at most that fraction of the cohort can be
+named "dropped" before the worker refuses further reveals and the
+round aborts. A reporter that dies *during* recovery also makes the
+round unrecoverable; the manager then aborts and keeps the previous
+global params, which is safe. Round-binding the seed hash prevents
+cross-round mask replay.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from typing import Dict, Mapping, Sequence, Tuple
+
+import numpy as np
+
+from baton_tpu.ops.secure_agg import DEFAULT_SCALE_BITS
+
+_RING_BITS = 64
+_RING = 1 << _RING_BITS
+
+
+def quantize64(
+    state: Mapping[str, np.ndarray], scale_bits: int = DEFAULT_SCALE_BITS
+) -> Dict[str, np.ndarray]:
+    """Float state dict -> uint64 fixed point (two's complement in
+    Z_2^64). int64 intermediates hold scale_bits=16 magnitudes up to
+    2^47 exactly — the sample-weighted sums this protocol ships."""
+    scale = float(1 << scale_bits)
+    return {
+        k: np.round(np.asarray(v, np.float64) * scale)
+        .astype(np.int64)
+        .astype(np.uint64)
+        for k, v in state.items()
+    }
+
+
+def dequantize64(
+    state: Mapping[str, np.ndarray], scale_bits: int = DEFAULT_SCALE_BITS
+) -> Dict[str, np.ndarray]:
+    """uint64 ring elements -> float64; values >= 2^63 read as negative."""
+    scale = float(1 << scale_bits)
+    out = {}
+    for k, v in state.items():
+        signed = np.asarray(v, np.uint64).astype(np.int64)  # two's complement
+        out[k] = signed.astype(np.float64) / scale
+    return out
+
+# RFC 3526 group 14: 2048-bit MODP prime, generator 2. A fixed,
+# nothing-up-my-sleeve group (pi-derived) — the standard choice for
+# finite-field DH without external crypto dependencies.
+MODP_P = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16,
+)
+MODP_G = 2
+_SK_BITS = 256  # exponent size; 2^256 work factor ≫ the group's ~110-bit strength
+
+
+def dh_keypair() -> Tuple[int, int]:
+    """Fresh per-round DH keypair (sk, pk = g^sk mod p)."""
+    sk = secrets.randbits(_SK_BITS) | 1
+    return sk, pow(MODP_G, sk, MODP_P)
+
+
+def dh_shared_seed(sk: int, pk_other: int, context: str) -> bytes:
+    """32-byte pairwise seed: SHA-256(context ‖ g^(sk_i·sk_j) mod p).
+
+    Symmetric in the pair by DH; ``context`` (the round name) binds masks
+    to one round so a replayed upload can't be unmasked with old seeds.
+    """
+    if not 1 < pk_other < MODP_P - 1:
+        raise ValueError("invalid DH public key")
+    shared = pow(pk_other, sk, MODP_P)
+    return hashlib.sha256(
+        context.encode() + b"|" + shared.to_bytes(256, "big")
+    ).digest()
+
+
+def _pair_sign(my_id: str, other_id: str) -> int:
+    """Mask sign convention: the lexicographically-smaller client_id adds
+    the pair's mask, the larger subtracts it — identical on every party
+    with no coordination."""
+    if my_id == other_id:
+        raise ValueError("no pairwise mask with self")
+    return 1 if my_id < other_id else -1
+
+
+def pair_mask(seed: bytes, template: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Deterministic uniform-uint64 mask per tensor from a 32-byte seed.
+
+    Philox (256-bit key = the seed) is counter-based and bit-identical
+    across platforms, so client-side masking and server-side dropout
+    recovery derive the same stream from the same seed.
+    """
+    words = np.frombuffer(seed, dtype=np.uint64)  # 4 × uint64
+    gen = np.random.Generator(
+        np.random.Philox(
+            key=words[:2],  # Philox keys are 128-bit
+            counter=np.concatenate([words[2:], np.zeros(2, np.uint64)]),
+        )
+    )
+    # one stream, consumed in sorted-name order: client masking and
+    # server recovery must draw identical bits even if their state dicts
+    # were built in different insertion orders
+    out = {}
+    for name in sorted(template):
+        out[name] = gen.integers(
+            0, 1 << 64, size=np.shape(template[name]), dtype=np.uint64
+        )
+    return out
+
+
+def mask_state_dict(
+    state: Mapping[str, np.ndarray],
+    my_id: str,
+    pair_seeds: Mapping[str, bytes],
+    scale_bits: int = DEFAULT_SCALE_BITS,
+) -> Dict[str, np.ndarray]:
+    """Client-side: quantize ``state`` and add every pairwise mask.
+
+    ``pair_seeds`` maps each *other* cohort member's client_id to the DH
+    seed shared with it. The result is uint64 ring elements — uniform
+    noise to anyone missing the seeds.
+    """
+    out = quantize64(state, scale_bits)
+    for other_id, seed in pair_seeds.items():
+        sign = _pair_sign(my_id, other_id)
+        mask = pair_mask(seed, out)
+        for k in out:
+            if sign > 0:
+                out[k] = (out[k] + mask[k]).astype(np.uint64)
+            else:
+                out[k] = (out[k] - mask[k]).astype(np.uint64)
+    return out
+
+
+def modular_sum(updates: Sequence[Mapping[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    """Σ mod 2^64 over masked uploads (server-side)."""
+    total = {k: np.asarray(v, np.uint64).copy() for k, v in updates[0].items()}
+    for u in updates[1:]:
+        for k in total:
+            total[k] = (total[k] + np.asarray(u[k], np.uint64)).astype(np.uint64)
+    return total
+
+
+def dropout_correction(
+    dropped_id: str,
+    revealed_seeds: Mapping[str, bytes],
+    template: Mapping[str, np.ndarray],
+) -> Dict[str, np.ndarray]:
+    """Server-side: the additive correction cancelling a dropped client.
+
+    Each reporter i's upload contains ``sign(i, d)·mask(seed_id)`` toward
+    dropped client d; summing ``sign(d, i)·mask(seed_id)`` over the
+    reporters (whose seeds with d they revealed) is exactly the negation
+    of the residue.
+    """
+    corr = {
+        k: np.zeros(np.shape(v), np.uint64) for k, v in template.items()
+    }
+    for reporter_id, seed in revealed_seeds.items():
+        sign = _pair_sign(dropped_id, reporter_id)
+        mask = pair_mask(seed, template)
+        for k in corr:
+            if sign > 0:
+                corr[k] = (corr[k] + mask[k]).astype(np.uint64)
+            else:
+                corr[k] = (corr[k] - mask[k]).astype(np.uint64)
+    return corr
+
+
+def unmask_sum(
+    masked_sum: Mapping[str, np.ndarray],
+    corrections: Sequence[Mapping[str, np.ndarray]],
+    scale_bits: int = DEFAULT_SCALE_BITS,
+) -> Dict[str, np.ndarray]:
+    """Apply dropout corrections and dequantize to float64."""
+    total = {k: np.asarray(v, np.uint64).copy() for k, v in masked_sum.items()}
+    for corr in corrections:
+        for k in total:
+            total[k] = (total[k] + np.asarray(corr[k], np.uint64)).astype(np.uint64)
+    return dequantize64(total, scale_bits)

@@ -203,6 +203,51 @@ def main() -> None:
             log(f"fused path failed ({type(e).__name__}: {e}); "
                 "keeping per-round number")
 
+    # --- flash-attention micro-bench: Pallas kernel vs dense einsum ---
+    # The model zoo defaults to the flash kernel on TPU
+    # (models/transformer.py::default_attention); this validates that the
+    # default is actually the faster kernel at training sequence lengths.
+    attn_bench = None
+    if platform == "tpu" and remaining() > 45.0:
+        try:
+            from baton_tpu.models.transformer import dot_product_attention
+            from baton_tpu.ops.flash_attention import flash_attention
+
+            def time_attn(fn, L, iters=10):
+                kq, kk, kv = jax.random.split(jax.random.key(7), 3)
+                shape = (4, 8, L, 64)  # [B, H, L, Dh]
+                q = jax.random.normal(kq, shape, jnp.bfloat16)
+                k = jax.random.normal(kk, shape, jnp.bfloat16)
+                v = jax.random.normal(kv, shape, jnp.bfloat16)
+
+                def loss(q):
+                    return jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32))
+
+                g = jax.jit(jax.grad(loss))
+                g(q).block_until_ready()  # compile
+                t = time.perf_counter()
+                for _ in range(iters):
+                    out = g(q)
+                out.block_until_ready()
+                return (time.perf_counter() - t) / iters * 1e3  # ms
+
+            attn_bench = {}
+            for L in (512, 2048):
+                if remaining() < 25.0:
+                    break
+                dense_ms = time_attn(dot_product_attention, L)
+                flash_ms = time_attn(flash_attention, L)
+                attn_bench[f"L{L}"] = {
+                    "dense_ms": round(dense_ms, 2),
+                    "flash_ms": round(flash_ms, 2),
+                    "speedup": round(dense_ms / flash_ms, 2),
+                }
+                log(f"attention fwd+bwd L={L}: dense {dense_ms:.2f}ms "
+                    f"flash {flash_ms:.2f}ms")
+        except Exception as e:
+            log(f"attention micro-bench failed ({type(e).__name__}: {e})")
+            attn_bench = None
+
     best = max(rounds_per_sec, fused_rps or 0.0)
     samples_per_sec = best * n_clients * samples_per_client * N_EPOCHS
     print(json.dumps({
@@ -218,6 +263,7 @@ def main() -> None:
         "samples_per_sec_per_chip": round(samples_per_sec, 1),
         "dispatch_rounds_per_sec": round(rounds_per_sec, 3),
         "fused_rounds_per_sec": round(fused_rps, 3) if fused_rps else None,
+        "attention_bench": attn_bench,
     }))
 
 

@@ -60,6 +60,13 @@ def run(n_clients=8, n_per_client=24, n_rounds=3, n_epochs=2,
         batch_size=8, mu=0.1, config=None, seed=0,
         real_data=False, data_dir=None):
     cfg = config or BertConfig.tiny(n_classes=4)
+    if real_data and cfg.vocab_size < 257:
+        # byte-level tokenizer emits ids 0..256 (PAD=256); a smaller
+        # embedding table would silently clamp half the vocabulary
+        # (JAX gathers clamp out-of-range indices rather than raise)
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, vocab_size=257)
     rng = np.random.default_rng(seed)
     shards = (
         make_ag_news_data(rng, cfg, n_clients, n_per_client, data_dir=data_dir)

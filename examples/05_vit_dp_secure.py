@@ -23,7 +23,12 @@ import numpy as np
 
 from baton_tpu.models.vit import ViTConfig, vit_model
 from baton_tpu.ops.padding import stack_client_datasets
-from baton_tpu.ops.privacy import DPConfig, rdp_epsilon
+from baton_tpu.ops.privacy import (
+    DPConfig,
+    poisson_sample,
+    rdp_epsilon,
+    subsampled_rdp_epsilon,
+)
 from baton_tpu.ops.secure_agg import aggregate_masked, mask_update
 from baton_tpu.parallel.engine import FedSim
 
@@ -58,18 +63,31 @@ def run(n_clients=4, n_per_client=16, n_rounds=2, n_epochs=1, batch_size=8,
     sim = FedSim(model, batch_size=batch_size, learning_rate=1e-2, dp=dp)
     params = sim.init(jax.random.key(seed))
 
+    # Poisson client sampling each round: amplification-by-subsampling
+    # needs the cohort drawn independently per round, not a fixed schedule
+    cohort_rate = 1.0 if n_clients <= 2 else 0.75
     history = []
     for r in range(n_rounds):
+        cohort = poisson_sample(rng, n_clients, cohort_rate)
+        if cohort.size == 0:  # empty cohort: round is a no-op
+            continue
         res = sim.run_round(params, data, n_samples,
                             jax.random.fold_in(jax.random.key(seed + 1), r),
-                            n_epochs=n_epochs)
+                            n_epochs=n_epochs,
+                            client_indices=cohort)
         params = res.params
         history.extend(float(x) for x in res.loss_history)
 
     steps = n_rounds * n_epochs * (int(data["x"].shape[1]) // batch_size)
     eps = rdp_epsilon(noise_multiplier, steps, delta)
+    # Amplified bound: each local step touches a batch_size/n_per_client
+    # Poisson fraction of a silo's examples (the standard DP-SGD
+    # accounting approximation for shuffled batches)
+    q = batch_size / n_per_client
+    eps_amp = subsampled_rdp_epsilon(noise_multiplier, steps, delta, q)
     print(f"DP-SGD: clip {clip_norm}, noise x{noise_multiplier} -> "
-          f"epsilon {eps:.2f} at delta={delta} after {steps} local steps")
+          f"epsilon {eps:.2f} at delta={delta} after {steps} local steps "
+          f"({eps_amp:.2f} with subsampling amplification at q={q:.3f})")
     print(f"loss: {history[0]:.4f} -> {history[-1]:.4f}")
 
     # --- secure aggregation of one round's client deltas -------------

@@ -22,7 +22,10 @@ from baton_tpu.ops.privacy import (
     dp_sgd_grads,
     global_norm,
     per_example_clipped_grad_sum,
+    poisson_sample,
     rdp_epsilon,
+    sampled_gaussian_rdp,
+    subsampled_rdp_epsilon,
 )
 from baton_tpu.ops.secure_agg import (
     aggregate_masked,
@@ -176,6 +179,54 @@ def test_rdp_accounting_monotonic():
     # 4x steps costs more than 1x but at most 4x epsilon (RDP composition
     # is additive; the RDP->DP conversion is subadditive in steps)
     assert e1 < e3 <= 4 * e1
+
+
+def test_subsampled_accounting_canonical_mnist():
+    """The accountant must reproduce the canonical DP-SGD MNIST numbers:
+    σ=1.1, q=256/60000, 60 epochs, δ=1e-5 → ε=3.0 under the classic
+    RDP→DP conversion (the number every DP-SGD paper/tutorial quotes),
+    and the tighter CKS conversion the library reports comes in below it.
+    """
+    import math
+
+    from baton_tpu.ops.privacy import INT_ORDERS
+
+    q = 256 / 60000
+    steps = int(60 * 60000 / 256)
+    rdp = sampled_gaussian_rdp(q, 1.1, INT_ORDERS) * steps
+    classic = min(
+        r + math.log(1e5) / (a - 1) for r, a in zip(rdp, INT_ORDERS)
+    )
+    assert abs(classic - 3.0) < 0.05, classic
+    tight = subsampled_rdp_epsilon(1.1, steps, 1e-5, q)
+    assert 2.0 < tight < classic
+
+
+def test_subsampled_accounting_limits():
+    # q=1 must recover the unamplified Gaussian RDP α/(2σ²) exactly
+    r = sampled_gaussian_rdp(1.0, 2.0, [2, 4, 8])
+    np.testing.assert_allclose(r, [a / 8.0 for a in (2, 4, 8)], rtol=1e-12)
+    # q=0: nothing is ever released
+    assert np.all(sampled_gaussian_rdp(0.0, 2.0, [2, 4]) == 0.0)
+    # amplification: subsampled ε must be far below unamplified at small q
+    full = rdp_epsilon(1.0, 1000, 1e-5)
+    amp = subsampled_rdp_epsilon(1.0, 1000, 1e-5, 0.01)
+    assert amp < full / 50
+    # monotone in q
+    assert amp < subsampled_rdp_epsilon(1.0, 1000, 1e-5, 0.1)
+    assert subsampled_rdp_epsilon(0.0, 10, 1e-5, 0.5) == float("inf")
+
+
+def test_poisson_sample_drives_cohorts(nprng):
+    counts = [poisson_sample(nprng, 200, 0.25).size for _ in range(50)]
+    m = np.mean(counts)
+    assert 35 < m < 65  # E=50, binomial std ~6.1
+    idx = poisson_sample(nprng, 100, 0.3)
+    assert np.all(np.diff(idx) > 0) and (idx.size == 0 or idx[-1] < 100)
+    assert poisson_sample(nprng, 100, 0.0).size == 0
+    assert poisson_sample(nprng, 100, 1.0).size == 100
+    with pytest.raises(ValueError):
+        poisson_sample(nprng, 10, 1.5)
 
 
 # ---------------------------------------------------------------------------

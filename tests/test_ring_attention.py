@@ -59,14 +59,6 @@ def test_ulysses_matches_dense(nprng, causal):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_ring_rejects_bias(nprng):
-    mesh = make_mesh(2, axis_names=("seq",))
-    q, k, v = _qkv(nprng, l=8)
-    ring = make_ring_attention_fn(mesh)
-    with pytest.raises(NotImplementedError):
-        ring(q, k, v, bias=jnp.zeros((2, 1, 1, 8)))
-
-
 def test_llama_with_ring_attention_matches_dense(nprng):
     """The attention_fn seam end-to-end: same params, same tokens, ring
     vs dense decoder forward passes agree."""

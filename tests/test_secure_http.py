@@ -1,0 +1,317 @@
+"""Secure aggregation as a wire protocol (server/secure.py).
+
+Offline layer: DH key agreement symmetry, pairwise-mask cancellation,
+dropout-correction algebra. HTTP layer: a real manager + 3 workers over
+sockets where the server only ever receives uint64-masked uploads, yet
+the aggregate equals plain weighted FedAvg — including a round where one
+cohort member silently drops after key exchange and the manager runs
+seed-reveal recovery with the survivors.
+"""
+
+import asyncio
+import socket
+
+import numpy as np
+from aiohttp import web
+
+from baton_tpu.core.training import make_local_trainer
+from baton_tpu.data.synthetic import linear_client_data
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.server import secure
+from baton_tpu.server.http_manager import Manager
+from baton_tpu.server.http_worker import ExperimentWorker
+from baton_tpu.server.state import params_to_state_dict
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ----------------------------------------------------------------------
+# offline protocol algebra
+
+
+def test_dh_seed_symmetry_and_round_binding():
+    sk1, pk1 = secure.dh_keypair()
+    sk2, pk2 = secure.dh_keypair()
+    s12 = secure.dh_shared_seed(sk1, pk2, "update_x_00001")
+    s21 = secure.dh_shared_seed(sk2, pk1, "update_x_00001")
+    assert s12 == s21 and len(s12) == 32
+    # a different round yields unrelated masks (no cross-round replay)
+    assert secure.dh_shared_seed(sk1, pk2, "update_x_00002") != s12
+    # degenerate public keys are rejected
+    for bad in (0, 1, secure.MODP_P - 1, secure.MODP_P):
+        try:
+            secure.dh_shared_seed(sk1, bad, "r")
+            assert False, "accepted degenerate pk"
+        except ValueError:
+            pass
+
+
+def _toy_states(nprng, n):
+    return [
+        {
+            "w": nprng.normal(size=(3, 2)).astype(np.float64),
+            "b": nprng.normal(size=(2,)).astype(np.float64),
+        }
+        for _ in range(n)
+    ]
+
+
+def _setup_cohort(n, round_name):
+    ids = [f"client_{i}" for i in range(n)]
+    keys = {cid: secure.dh_keypair() for cid in ids}
+    seeds = {
+        cid: {
+            other: secure.dh_shared_seed(
+                keys[cid][0], keys[other][1], round_name
+            )
+            for other in ids
+            if other != cid
+        }
+        for cid in ids
+    }
+    return ids, seeds
+
+
+def test_full_cohort_masks_cancel(nprng):
+    ids, seeds = _setup_cohort(4, "update_t_00000")
+    states = _toy_states(nprng, 4)
+    masked = [
+        secure.mask_state_dict(s, cid, seeds[cid])
+        for cid, s in zip(ids, states)
+    ]
+    # any single masked upload is garbage relative to its plaintext
+    one = secure.unmask_sum(masked[0], [])
+    assert max(np.max(np.abs(one[k] - states[0][k])) for k in one) > 1.0
+    # ...but the cohort sum is exact to quantization precision
+    total = secure.unmask_sum(secure.modular_sum(masked), [])
+    expected = {k: sum(s[k] for s in states) for k in states[0]}
+    for k in total:
+        np.testing.assert_allclose(total[k], expected[k], atol=1e-3)
+
+
+def test_dropout_correction_cancels_residue(nprng):
+    ids, seeds = _setup_cohort(4, "update_t_00001")
+    states = _toy_states(nprng, 4)
+    masked = [
+        secure.mask_state_dict(s, cid, seeds[cid])
+        for cid, s in zip(ids, states)
+    ]
+    # client 2 vanishes after masking; survivors' seeds with it recover it
+    dropped = ids[2]
+    survivors = [i for i in range(4) if i != 2]
+    revealed = {ids[i]: seeds[ids[i]][dropped] for i in survivors}
+    template = states[0]
+    corr = secure.dropout_correction(dropped, revealed, template)
+    total = secure.unmask_sum(
+        secure.modular_sum([masked[i] for i in survivors]), [corr]
+    )
+    expected = {k: sum(states[i][k] for i in survivors) for k in template}
+    for k in total:
+        np.testing.assert_allclose(total[k], expected[k], atol=1e-3)
+    # without the correction the survivor sum is garbage
+    raw = secure.unmask_sum(
+        secure.modular_sum([masked[i] for i in survivors]), []
+    )
+    assert max(np.max(np.abs(raw[k] - expected[k])) for k in raw) > 1.0
+
+
+def test_uint64_ring_survives_large_weighted_updates(nprng):
+    """Sample-weighted uploads (n·θ) overflow the 32-bit ring's 2^15
+    fixed-point budget with a single 40k-sample client; the wire
+    protocol's uint64 ring must stay exact."""
+    ids, seeds = _setup_cohort(2, "update_t_00002")
+    states = [
+        {k: np.asarray(v, np.float64) * 40000.0 for k, v in s.items()}
+        for s in _toy_states(nprng, 2)
+    ]
+    masked = [
+        secure.mask_state_dict(s, cid, seeds[cid])
+        for cid, s in zip(ids, states)
+    ]
+    total = secure.unmask_sum(secure.modular_sum(masked), [])
+    expected = {k: states[0][k] + states[1][k] for k in states[0]}
+    for k in total:
+        np.testing.assert_allclose(total[k], expected[k], atol=1e-3)
+
+
+# ----------------------------------------------------------------------
+# HTTP federation
+
+
+class _SilentWorker(ExperimentWorker):
+    """Completes key exchange and training but never uploads — the
+    dropout case the recovery flow exists for."""
+
+    async def report_update(self, round_name, n_samples, loss_history):
+        return None
+
+
+async def _secure_federation(n_workers, silent_last=False):
+    model = linear_regression_model(10)
+    nprng = np.random.default_rng(1)
+    mport = free_port()
+
+    mapp = web.Application()
+    manager = Manager(mapp)
+    exp = manager.register_experiment(
+        model, name="securetest", round_timeout=60.0, secure_agg=True
+    )
+    mrunner = web.AppRunner(mapp)
+    await mrunner.setup()
+    await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+    workers, runners = [], [mrunner]
+    for i in range(n_workers):
+        data = linear_client_data(nprng, min_batches=2, max_batches=3)
+        wport = free_port()
+        cls = (
+            _SilentWorker
+            if (silent_last and i == n_workers - 1)
+            else ExperimentWorker
+        )
+        wapp = web.Application()
+        worker = cls(
+            wapp,
+            model,
+            f"127.0.0.1:{mport}",
+            name="securetest",
+            port=wport,
+            heartbeat_time=5.0,
+            trainer=make_local_trainer(model, batch_size=32, learning_rate=0.02),
+            get_data=lambda d=data: (d, d["x"].shape[0]),
+        )
+        wrunner = web.AppRunner(wapp)
+        await wrunner.setup()
+        await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+        workers.append(worker)
+        runners.append(wrunner)
+
+    for _ in range(200):
+        if len(exp.registry) == n_workers:
+            break
+        await asyncio.sleep(0.05)
+    assert len(exp.registry) == n_workers
+    return exp, workers, runners, mport
+
+
+def test_secure_round_server_never_sees_raw_update():
+    async def main():
+        exp, workers, runners, mport = await _secure_federation(3)
+
+        # record every upload the server's round state ever holds
+        seen = []
+        orig = exp.rounds.client_end
+
+        def spy(cid, resp):
+            seen.append((cid, resp))
+            orig(cid, resp)
+
+        exp.rounds.client_end = spy
+
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{mport}/securetest/start_round?n_epoch=2"
+            ) as resp:
+                assert resp.status == 200
+                acks = await resp.json()
+                assert len(acks) == 3 and all(acks.values())
+            for _ in range(400):
+                if not exp.rounds.in_progress:
+                    break
+                await asyncio.sleep(0.05)
+        assert not exp.rounds.in_progress
+
+        # every upload the server observed was uint64-masked, and no
+        # single one dequantizes to anything near a real update
+        assert len(seen) == 3
+        for cid, resp in seen:
+            assert resp["masked"]
+            for arr in resp["state_dict"].values():
+                assert np.asarray(arr).dtype == np.uint64
+
+        # the aggregate equals plain weighted FedAvg of the workers'
+        # actual post-training params (which the server never saw)
+        num = None
+        den = 0.0
+        for w in workers:
+            sd = params_to_state_dict(w.params)
+            n = float(w.get_data()[1])
+            den += n
+            num = (
+                {k: n * np.asarray(v, np.float64) for k, v in sd.items()}
+                if num is None
+                else {k: num[k] + n * np.asarray(v, np.float64) for k, v in sd.items()}
+            )
+        expected = {k: v / den for k, v in num.items()}
+        got = params_to_state_dict(exp.params)
+        for k in expected:
+            np.testing.assert_allclose(got[k], expected[k], atol=1e-3)
+
+        for r in runners:
+            await r.cleanup()
+
+    run(main())
+
+
+def test_secure_round_dropout_recovery_over_http():
+    async def main():
+        exp, workers, runners, mport = await _secure_federation(
+            3, silent_last=True
+        )
+
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{mport}/securetest/start_round?n_epoch=2"
+            ) as resp:
+                assert resp.status == 200
+
+            # the two honest workers report; the silent one never does
+            for _ in range(400):
+                if len(exp.rounds.client_responses) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(exp.rounds.client_responses) == 2
+            assert exp.rounds.in_progress
+
+            # force-finish: triggers seed-reveal recovery for the dropout
+            async with session.get(
+                f"http://127.0.0.1:{mport}/securetest/end_round"
+            ) as resp:
+                state = await resp.json()
+            assert not state["in_progress"]
+
+        # aggregate equals weighted FedAvg over the two REPORTERS only
+        num, den = None, 0.0
+        for w in workers[:2]:
+            sd = params_to_state_dict(w.params)
+            n = float(w.get_data()[1])
+            den += n
+            num = (
+                {k: n * np.asarray(v, np.float64) for k, v in sd.items()}
+                if num is None
+                else {k: num[k] + n * np.asarray(v, np.float64) for k, v in sd.items()}
+            )
+        expected = {k: v / den for k, v in num.items()}
+        got = params_to_state_dict(exp.params)
+        for k in expected:
+            np.testing.assert_allclose(got[k], expected[k], atol=1e-3)
+
+        snap = exp.metrics.snapshot()
+        assert snap["counters"].get("secure_dropouts_recovered") == 1.0
+
+        for r in runners:
+            await r.cleanup()
+
+    run(main())
