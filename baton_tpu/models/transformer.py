@@ -133,26 +133,39 @@ def dot_product_attention(q, k, v, bias=None, causal=False):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+# dense materializes a [B, Hq, Lq, Lk] fp32 score tensor; beyond this
+# budget (or past the length where the Pallas kernel measures faster —
+# 4.1x at L=4096 on v5e, see bench.py's attention micro-bench) the
+# flash kernel takes over
+_FLASH_MIN_LEN = 4096
+_DENSE_SCORES_BUDGET_BYTES = 512 * 1024 ** 2
+
+
 def default_attention(q, k, v, bias=None, causal=False):
     """Backend-dispatching attention — the model zoo's default kernel.
 
-    On TPU this routes to the Pallas flash-attention kernel
+    On TPU, long sequences route to the Pallas flash-attention kernel
     (ops/flash_attention.py): O(L·block) memory instead of the dense
-    [B, H, L, L] score tensor, fused softmax, same numerics (fp32
-    softmax, GQA). Everywhere else (CPU tests, interpret mode) it stays
-    on the dense einsum path, which XLA:CPU handles better than the
-    interpreted Pallas kernel.
+    [B, H, L, L] score tensor, fused online softmax, same numerics
+    (fp32 softmax, GQA), measured 4x faster than the dense einsum at
+    L=4096 on v5e. Short sequences stay on the dense path — XLA's fused
+    attention wins there (measured crossover ~2-4k), and so does every
+    non-TPU backend (CPU tests would hit the interpreted Pallas kernel).
 
-    The dispatch happens at trace time (``jax.default_backend()`` is
-    ordinary Python), so the jitted program contains exactly one kernel
-    — there is no runtime branch. A ``bias`` that is not the standard
-    per-key [B, 1, 1, L] padding bias falls back to the dense kernel,
-    which accepts anything broadcastable to [B, Hq, L, L].
+    The dispatch happens at trace time (shapes and
+    ``jax.default_backend()`` are ordinary Python), so the jitted
+    program contains exactly one kernel — there is no runtime branch.
+    A ``bias`` that is not the standard per-key [B, 1, 1, L] padding
+    bias falls back to the dense kernel, which accepts anything
+    broadcastable to [B, Hq, L, L].
     """
     if jax.default_backend() == "tpu":
-        b, _, _, _ = q.shape
+        b, hq, lq, _ = q.shape
         lk = k.shape[2]
-        if bias is None or bias.shape == (b, 1, 1, lk):
+        scores_bytes = 4 * b * hq * lq * lk
+        if (lk >= _FLASH_MIN_LEN or scores_bytes > _DENSE_SCORES_BUDGET_BYTES) and (
+            bias is None or bias.shape == (b, 1, 1, lk)
+        ):
             from baton_tpu.ops.flash_attention import flash_attention
 
             return flash_attention(q, k, v, bias=bias, causal=causal)

@@ -63,75 +63,130 @@ def _default_interpret() -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
-                *, scale, causal, block_q, block_k, lk):
+                acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, nk):
+    # Grid (B, Hq, Lq/bq, Lk/bk) with the kv axis INNERMOST ('arbitrary'):
+    # the online-softmax state (acc/m/l) lives in VMEM scratch across the
+    # j loop while Mosaic double-buffers the k/v block DMAs — the r2
+    # whole-K/V-per-program version re-fetched all of K/V from HBM for
+    # every q block (nq× traffic) and could not overlap DMA with compute.
+    # m/l are (bq, 128) lane-broadcast: TPU vector layout wants the minor
+    # dim lane-aligned, so the scalar-per-row state rides 128 lanes.
     i = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, D]
-    nk = lk // block_k
+    j = pl.program_id(3)
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q_pos = i * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
+    # causal: a kv block strictly in every query's future contributes
+    # nothing — skip its matmuls (≈half the FLOPs on average)
+    needed = True
+    if causal:
+        needed = j * block_k <= i * block_q + (block_q - 1)
 
-    def body(j, carry):
-        m, l, acc = carry
-        kj = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, kj.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        s = s + b_ref[0, pl.ds(j * block_k, block_k)][None, :]
+    @pl.when(needed)
+    def _accumulate():
+        # operands stay in the input dtype (bf16 on the bf16 path): the
+        # MXU multiplies bf16 natively with fp32 accumulation via
+        # preferred_element_type — upcasting first would force 4-8x
+        # slower fp32 MXU passes. Softmax statistics are fp32 throughout.
+        q = q_ref[...]                                   # [bq, D]
+        kj = k_ref[...]                                  # [bk, D]
+        vj = v_ref[...]
+        # contract D via dot_general — an explicit kj.T would force a
+        # Mosaic relayout before the MXU op
+        s = lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = s + b_ref[...]                               # [1, bk] bias
         if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
             k_pos = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
+        m_prev = m_ref[:, :1]                            # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(
-            p, vj, preferred_element_type=jnp.float32
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p.astype(vj.dtype), vj, preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    @pl.when(j == nk - 1)
+    def _finalize():
+        m = m_ref[:, :1]
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0, :] = (m + jnp.log(l))[:, 0]
+
+
+def _compiler_params(n_parallel: int):
+    """Mark the leading grid axes parallel, the innermost sequential."""
+    if _VMEM is None:  # pragma: no cover
+        return None
+    semantics = ("parallel",) * n_parallel + ("arbitrary",)
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    return cls(dimension_semantics=semantics) if cls else None
+
+
+def _scratch(shape, dtype=jnp.float32):
+    if _VMEM is None:  # pragma: no cover
+        raise RuntimeError("pallas TPU memory spaces unavailable")
+    return _VMEM(shape, dtype)
 
 
 def _fwd(q, k, v, bias2d, causal, scale, block_q, block_k, interpret):
     b, hq, lq, d = q.shape
     hkv, lk = k.shape[1], k.shape[2]
     group = hq // hkv
-    grid = (b, hq, lq // block_q)
+    nk = lk // block_k
+    grid = (b, hq, lq // block_q, nk)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, lk=lk,
+        block_q=block_q, block_k=block_k, nk=nk,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            _spec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
-            _spec((1, 1, lk, d), lambda b_, h, i: (b_, h // group, 0, 0)),
-            _spec((1, 1, lk, d), lambda b_, h, i: (b_, h // group, 0, 0)),
-            _spec((1, lk), lambda b_, h, i: (b_, 0)),
+            _spec((None, None, block_q, d), lambda b_, h, i, j: (b_, h, i, 0)),
+            _spec((None, None, block_k, d),
+                  lambda b_, h, i, j: (b_, h // group, j, 0)),
+            _spec((None, None, block_k, d),
+                  lambda b_, h, i, j: (b_, h // group, j, 0)),
+            _spec((None, 1, block_k), lambda b_, h, i, j: (b_, 0, j)),
         ],
         out_specs=[
-            _spec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
-            _spec((1, 1, block_q), lambda b_, h, i: (b_, h, i)),
+            _spec((None, None, block_q, d), lambda b_, h, i, j: (b_, h, i, 0)),
+            _spec((None, None, 1, block_q), lambda b_, h, i, j: (b_, h, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hq, lq), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, 1, lq), jnp.float32),
         ],
+        scratch_shapes=[
+            _scratch((block_q, d)),
+            _scratch((block_q, 128)),
+            _scratch((block_q, 128)),
+        ],
+        compiler_params=None if interpret else _compiler_params(3),
         interpret=interpret,
-    )(q, k, v, bias2d)
-    return out, lse
+    )(q, k, v, bias2d.reshape(b, 1, lk))
+    return out, lse.reshape(b, hq, lq)
 
 
 # ======================================================================
@@ -153,19 +208,22 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, b_ref,
 
     @pl.when(i == 0)
     def _init():
-        dk_ref[0, 0] = jnp.zeros_like(dk_ref[0, 0])
-        dv_ref[0, 0] = jnp.zeros_like(dv_ref[0, 0])
-        db_ref[0, 0] = jnp.zeros_like(db_ref[0, 0])
+        dk_ref[...] = jnp.zeros_like(dk_ref[...])
+        dv_ref[...] = jnp.zeros_like(dv_ref[...])
+        db_ref[...] = jnp.zeros_like(db_ref[...])
 
-    qi = q_ref[0, 0].astype(jnp.float32) * scale               # [bq, D]
-    doi = do_ref[0, 0].astype(jnp.float32)                     # [bq, D]
-    lsei = lse_ref[0, 0][:, None]                              # [bq, 1]
-    delta = delta_ref[0, 0][:, None]                           # [bq, 1]
-    kj = k_ref[0, 0].astype(jnp.float32)                       # [bk, D]
-    vj = v_ref[0, 0].astype(jnp.float32)
-    bj = b_ref[0][None, :]                                     # [1, bk]
+    qi = q_ref[...]                                            # [bq, D]
+    doi = do_ref[...]                                          # [bq, D]
+    lsei = lse_ref[0][:, None]                                 # [bq, 1]
+    delta = delta_ref[0][:, None]                              # [bq, 1]
+    kj = k_ref[...]                                            # [bk, D]
+    vj = v_ref[...]
+    bj = b_ref[...]                                            # [1, bk]
 
-    s = jnp.dot(qi, kj.T, preferred_element_type=jnp.float32) + bj
+    s = (lax.dot_general(
+        qi, kj, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + bj)
     if causal:
         q_pos = i * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -175,11 +233,21 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, b_ref,
         )
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     p = jnp.exp(s - lsei)                                      # [bq, bk]
-    dp = jnp.dot(doi, vj.T, preferred_element_type=jnp.float32)
+    dp = lax.dot_general(
+        doi, vj, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     ds = p * (dp - delta)                                      # [bq, bk]
-    dv_ref[0, 0] += jnp.dot(p.T, doi, preferred_element_type=jnp.float32)
-    dk_ref[0, 0] += jnp.dot(ds.T, qi, preferred_element_type=jnp.float32)
-    db_ref[0, 0] += ds.sum(axis=0)
+    # contract the bq axis directly (p^T·do, ds^T·q without transposes)
+    dv_ref[...] += lax.dot_general(
+        p.astype(doi.dtype), doi, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dk_ref[...] += scale * lax.dot_general(
+        ds.astype(qi.dtype), qi, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    db_ref[...] += ds.sum(axis=0)[None, :]
 
 
 def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, b_ref,
@@ -189,17 +257,20 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, b_ref,
 
     @pl.when(j == 0)
     def _init():
-        dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
+        dq_ref[...] = jnp.zeros_like(dq_ref[...])
 
-    qi = q_ref[0, 0].astype(jnp.float32) * scale
-    doi = do_ref[0, 0].astype(jnp.float32)
-    lsei = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
-    kj = k_ref[0, 0].astype(jnp.float32)
-    vj = v_ref[0, 0].astype(jnp.float32)
-    bj = b_ref[0][None, :]
+    qi = q_ref[...]
+    doi = do_ref[...]
+    lsei = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    kj = k_ref[...]
+    vj = v_ref[...]
+    bj = b_ref[...]
 
-    s = jnp.dot(qi, kj.T, preferred_element_type=jnp.float32) + bj
+    s = (lax.dot_general(
+        qi, kj, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale + bj)
     if causal:
         q_pos = i * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -209,10 +280,13 @@ def _bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, b_ref,
         )
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     p = jnp.exp(s - lsei)
-    dp = jnp.dot(doi, vj.T, preferred_element_type=jnp.float32)
+    dp = lax.dot_general(
+        doi, vj, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     ds = p * (dp - delta)
-    dq_ref[0, 0] += scale * jnp.dot(
-        ds, kj, preferred_element_type=jnp.float32
+    dq_ref[...] += scale * jnp.dot(
+        ds.astype(kj.dtype), kj, preferred_element_type=jnp.float32
     )
 
 
@@ -227,17 +301,23 @@ def _bwd_call(q, k, v, bias2d, out, dout, lse,
     delta = jnp.sum(
         dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
+    # low-rank operands get an explicit size-1 second-minor dim so their
+    # kept last-two block dims satisfy Mosaic's (8, 128) tiling rule
+    lse4 = lse.reshape(b, hq, 1, lq)
+    delta4 = delta.reshape(b, hq, 1, lq)
+    bias3 = bias2d.reshape(b, 1, lk)
 
     def in_specs(qi, kj):
         """Common input specs; ``qi``/``kj`` pick the q/kv block index out
         of the two trailing grid axes (x, y)."""
-        q_spec = _spec((1, 1, block_q, d),
+        q_spec = _spec((None, None, block_q, d),
                        lambda b_, h, x, y: (b_, h, qi(x, y), 0))
-        lse_spec = _spec((1, 1, block_q),
-                         lambda b_, h, x, y: (b_, h, qi(x, y)))
-        kv_spec = _spec((1, 1, block_k, d),
+        lse_spec = _spec((None, None, 1, block_q),
+                         lambda b_, h, x, y: (b_, h, 0, qi(x, y)))
+        kv_spec = _spec((None, None, block_k, d),
                         lambda b_, h, x, y: (b_, h // group, kj(x, y), 0))
-        bias_spec = _spec((1, block_k), lambda b_, h, x, y: (b_, kj(x, y)))
+        bias_spec = _spec((None, 1, block_k),
+                          lambda b_, h, x, y: (b_, 0, kj(x, y)))
         return [q_spec, q_spec, lse_spec, lse_spec,
                 kv_spec, kv_spec, bias_spec]
 
@@ -248,17 +328,18 @@ def _bwd_call(q, k, v, bias2d, out, dout, lse,
         grid=(b, hq, nk, nq),
         in_specs=in_specs(qi=lambda x, y: y, kj=lambda x, y: x),
         out_specs=[
-            _spec((1, 1, block_k, d), lambda b_, h, x, y: (b_, h, x, 0)),
-            _spec((1, 1, block_k, d), lambda b_, h, x, y: (b_, h, x, 0)),
-            _spec((1, 1, block_k), lambda b_, h, x, y: (b_, h, x)),
+            _spec((None, None, block_k, d), lambda b_, h, x, y: (b_, h, x, 0)),
+            _spec((None, None, block_k, d), lambda b_, h, x, y: (b_, h, x, 0)),
+            _spec((None, None, 1, block_k), lambda b_, h, x, y: (b_, h, 0, x)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, lk, d), jnp.float32),
             jax.ShapeDtypeStruct((b, hq, lk, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, hq, lk), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, 1, lk), jnp.float32),
         ],
+        compiler_params=None if interpret else _compiler_params(3),
         interpret=interpret,
-    )(q, dout, lse, delta, k, v, bias2d)
+    )(q, dout, lse4, delta4, k, v, bias3)
 
     # pass 2: dq — grid (…, q, kv), kv innermost (accumulated over)
     (dq,) = pl.pallas_call(
@@ -267,18 +348,19 @@ def _bwd_call(q, k, v, bias2d, out, dout, lse,
         grid=(b, hq, nq, nk),
         in_specs=in_specs(qi=lambda x, y: x, kj=lambda x, y: y),
         out_specs=[
-            _spec((1, 1, block_q, d), lambda b_, h, x, y: (b_, h, x, 0)),
+            _spec((None, None, block_q, d), lambda b_, h, x, y: (b_, h, x, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, lq, d), jnp.float32),
         ],
+        compiler_params=None if interpret else _compiler_params(3),
         interpret=interpret,
-    )(q, dout, lse, delta, k, v, bias2d)
+    )(q, dout, lse4, delta4, k, v, bias3)
 
     # per-query-head kv grads fold back onto the Hkv axis (GQA)
     dk = dk_h.reshape(b, hkv, group, lk, d).sum(axis=2)
     dv = dv_h.reshape(b, hkv, group, lk, d).sum(axis=2)
-    dbias = db_h.sum(axis=1)                                   # [B, Lk]
+    dbias = db_h[:, :, 0].sum(axis=1)                          # [B, Lk]
     return dq, dk, dv, dbias
 
 
@@ -326,8 +408,8 @@ def flash_attention(
     v: jax.Array,
     bias: Optional[jax.Array] = None,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention matching ``dot_product_attention`` semantics
@@ -392,7 +474,7 @@ def _round_pow2(n: int) -> int:
     return p
 
 
-def make_flash_attention_fn(block_q: int = 128, block_k: int = 128,
+def make_flash_attention_fn(block_q: int = 512, block_k: int = 1024,
                             interpret: Optional[bool] = None):
     """Seam-compatible ``attention_fn`` (transformer.py:31-32) for any
     model in the zoo: ``model(..., attention_fn=make_flash_attention_fn())``."""

@@ -21,13 +21,15 @@ Differences from the reference (each a recorded fix, SURVEY §2.9):
 * weight upload is BTW1 (no unpickling network bytes) unless
   ``allow_pickle=True`` opts into reference-demo compatibility.
 
-With ``secure_agg=True`` the experiment speaks the secure-aggregation
-protocol (server/secure.py): ``start_round`` first runs DH key agreement
-against each worker's ``POST /{name}/secure_keys``, the broadcast
-carries the cohort's public-key directory, uploads arrive masked
-(uint64 ring elements the server cannot read individually), and
-finalization cancels dropped clients' residual masks via per-pair seed
-reveals (``GET /{worker}/reveal``) before dequantizing the sum.
+With ``secure_agg=True`` the experiment speaks the Bonawitz
+double-masking protocol (server/secure.py): ``start_round`` runs
+AdvertiseKeys (``POST /{worker}/secure_keys``) then ShareKeys
+(``POST /{worker}/secure_shares``), the broadcast relays each member's
+sealed Shamir-share boxes, uploads arrive pairwise+self masked (uint64
+ring elements the server cannot read individually), and finalization
+reconstructs dropped members' mask keys and reporters' self-mask seeds
+from ≥t shares (``POST /{worker}/secure_unmask``) before dequantizing
+the sum.
 
 Aggregation is the engine's weighted tree mean — numerically the
 reference formula ``Σ(w·θ)/Σw`` (manager.py:119-126) — and an attached
@@ -133,6 +135,7 @@ class Experiment:
         self.secure_scale_bits = secure_scale_bits
         # live secure round: {"round_name", "cohort": [ids], "pks": {id: int}}
         self._secure_round: Optional[dict] = None
+        self._secure_outboxes: Optional[dict] = None
         self._secure_task = None
         self._secure_finalizing = False
         self._checkpoint_task = None
@@ -284,7 +287,7 @@ class Experiment:
             return web.json_response({"error": "Round Finalizing"}, status=410)
         if (
             self._secure_round is not None
-            and client_id not in self._secure_round["pks"]
+            and client_id not in self._secure_round["cohort"]
         ):
             # not in this round's cohort: its masks reference a pk
             # directory nobody else holds (e.g. a straggler from an
@@ -334,25 +337,46 @@ class Experiment:
         state_dict = params_to_state_dict(self.params)
         meta = {"update_name": round_name, "n_epoch": n_epoch}
         if self.secure_agg:
-            # Phase 1 (server/secure.py): per-round DH key agreement.
-            # Clients that fail key exchange are excluded from the cohort
-            # BEFORE the pk directory is broadcast, so every mask a
-            # client adds corresponds to a cohort member the server
-            # knows about (and can run dropout recovery against).
+            # Bonawitz round 0 (AdvertiseKeys): per-round DH key
+            # agreement. Clients that fail are excluded BEFORE the pk
+            # directory circulates.
             pk_results = await asyncio.gather(
                 *[
                     self._collect_pk(cid, round_name)
                     for cid in list(self.registry.clients)
                 ]
             )
-            pks = {cid: pk for cid, pk in pk_results if pk is not None}
+            pks = {cid: p for cid, p in pk_results if p is not None}
             if not pks:
+                self.rounds.abort_round()
+                return {}
+            cohort_a = sorted(pks)
+            t = len(cohort_a) // 2 + 1  # honest majority threshold
+            # Bonawitz round 1 (ShareKeys): every member Shamir-shares
+            # its self-mask seed and mask key across the cohort; the
+            # sealed boxes are relayed (opaque to this server) inside
+            # the round_start broadcast. Members that fail here never
+            # distributed shares, so nobody may mask toward them — the
+            # masking cohort is exactly the successful sharers.
+            share_results = await asyncio.gather(
+                *[
+                    self._collect_shares(cid, round_name, pks, t)
+                    for cid in cohort_a
+                ]
+            )
+            outboxes = {cid: m for cid, m in share_results if m is not None}
+            cohort = sorted(outboxes)
+            if len(cohort) < t:
+                # fewer sharers than the reconstruction threshold: the
+                # round could never be unmasked — abort before training
                 self.rounds.abort_round()
                 return {}
             self._secure_round = {
                 "round_name": round_name,
-                "cohort": sorted(pks),
-                "pks": pks,
+                "cohort": cohort,
+                "index": {cid: x + 1 for x, cid in enumerate(cohort_a)},
+                "t": t,
+                "c_pks": {cid: p[0] for cid, p in pks.items()},
                 "scale_bits": self.secure_scale_bits,
                 # validation template cached once per round: per-upload
                 # params_to_state_dict would device-to-host copy the full
@@ -363,10 +387,11 @@ class Experiment:
                 },
             }
             meta["secure"] = {
-                "cohort": sorted(pks),
-                "pks": {cid: f"{pk:x}" for cid, pk in pks.items()},
+                "cohort": cohort,
                 "scale_bits": self.secure_scale_bits,
+                # inbox is per-recipient — filled in the broadcast loop
             }
+            self._secure_outboxes = outboxes
         if self.allow_pickle:
             # Reference-protocol broadcast (manager.py:77-86): stock
             # reference workers can only decode pickled state_dicts, so
@@ -384,15 +409,31 @@ class Experiment:
         # this exact race, manager.py:87-89). _broadcasting additionally
         # keeps _maybe_finish from ending/aborting the round while acks
         # are still arriving.
-        recipients = (
-            self._secure_round["cohort"]
-            if self._secure_round is not None
-            else list(self.registry.clients)
-        )
+        if self._secure_round is not None:
+            # per-recipient bodies: each cohort member's broadcast
+            # carries ITS inbox of sealed share boxes from the others
+            recipients = self._secure_round["cohort"]
+            outboxes = self._secure_outboxes
+            bodies = {}
+            for cid in recipients:
+                inbox = {
+                    sender: outboxes[sender].get(cid)
+                    for sender in recipients
+                    if sender != cid and outboxes[sender].get(cid)
+                }
+                m = dict(meta)
+                m["secure"] = dict(meta["secure"], inbox=inbox)
+                bodies[cid] = wire.encode(state_dict, m)
+        else:
+            recipients = list(self.registry.clients)
+            bodies = {cid: body for cid in recipients}
         self._broadcasting = True
         try:
             results = await asyncio.gather(
-                *[self._notify_client(cid, body, ctype) for cid in recipients]
+                *[
+                    self._notify_client(cid, bodies[cid], ctype)
+                    for cid in recipients
+                ]
             )
         finally:
             self._broadcasting = False
@@ -412,54 +453,83 @@ class Experiment:
         self._maybe_finish()
         return dict(results)
 
-    async def _collect_pk(self, client_id: str, round_name: str):
-        """Secure-round key agreement with one client; eager eviction on
-        failure mirrors _notify_client (a client that can't answer key
-        exchange won't answer the broadcast either)."""
+    async def _secure_post(self, client_id: str, endpoint: str, payload: dict):
+        """POST a secure-protocol message to one worker; None on any
+        failure (the protocol tolerates per-member failures by cohort
+        exclusion or share-threshold slack)."""
         try:
             client = self.registry[client_id]
         except UnknownClient:
-            return client_id, None  # culled between snapshot and task run
+            return None  # culled between snapshot and task run
         url = (
-            f"{client.url.rstrip('/')}/secure_keys"
+            f"{client.url.rstrip('/')}/{endpoint}"
             f"?client_id={client_id}&key={client.key}"
         )
         try:
-            async with self._session.post(
-                url, json={"round": round_name}
-            ) as resp:
+            async with self._session.post(url, json=payload) as resp:
                 if resp.status == 200:
-                    data = await resp.json()
-                    return client_id, int(data["pk"], 16)
+                    return await resp.json()
                 if resp.status == 404:
                     self.registry.drop(client_id)
-                # 409 (worker mid-round) etc.: alive but unavailable this
-                # round — excluded from the cohort, kept registered
+                # 409/410 etc.: alive but unavailable for this round
         except (aiohttp.ClientError, ValueError, KeyError):
             self.registry.drop(client_id)
-        return client_id, None
+        return None
 
-    async def _request_reveal(
-        self, client_id: str, round_name: str, dropped_id: str
-    ) -> Optional[bytes]:
-        """Ask a reporter for its pairwise seed with a dropped client."""
-        try:
-            client = self.registry[client_id]
-        except UnknownClient:
-            return None
-        url = (
-            f"{client.url.rstrip('/')}/reveal"
-            f"?client_id={client_id}&key={client.key}"
-            f"&round={round_name}&dropped={dropped_id}"
+    async def _collect_pk(self, client_id: str, round_name: str):
+        """AdvertiseKeys with one client → (cid, (c_pk, s_pk) | None).
+
+        Degenerate public keys (0/1/p−1 — a Byzantine member's subgroup
+        confinement) are rejected HERE so they never enter the directory:
+        forwarded, they would make every honest worker's share-sealing
+        loop fail and kill the whole cohort every round."""
+        from baton_tpu.server import secure
+
+        data = await self._secure_post(
+            client_id, "secure_keys", {"round": round_name}
         )
         try:
-            async with self._session.get(url) as resp:
-                if resp.status != 200:
-                    return None
-                data = await resp.json()
-                return bytes.fromhex(data["seed"])
-        except (aiohttp.ClientError, ValueError, KeyError):
-            return None
+            c_pk, s_pk = int(data["c_pk"], 16), int(data["s_pk"], 16)
+            if not (1 < c_pk < secure.MODP_P - 1):
+                return client_id, None
+            if not (1 < s_pk < secure.MODP_P - 1):
+                return client_id, None
+            return client_id, (c_pk, s_pk)
+        except (TypeError, KeyError, ValueError):
+            return client_id, None
+
+    async def _collect_shares(
+        self, client_id: str, round_name: str, pks: dict, t: int
+    ):
+        """ShareKeys with one client → (cid, {recipient: sealed_hex})."""
+        data = await self._secure_post(
+            client_id,
+            "secure_shares",
+            {
+                "round": round_name,
+                "pks": {
+                    cid: {"c": f"{c:x}", "s": f"{s:x}"}
+                    for cid, (c, s) in pks.items()
+                },
+                "t": t,
+            },
+        )
+        try:
+            return client_id, {
+                str(k): str(v) for k, v in data["shares"].items()
+            }
+        except (TypeError, KeyError, AttributeError):
+            return client_id, None
+
+    async def _request_unmask(
+        self, client_id: str, round_name: str, survivors, dropped
+    ):
+        """Unmasking with one reporter → its share bundle or None."""
+        return await self._secure_post(
+            client_id,
+            "secure_unmask",
+            {"round": round_name, "survivors": survivors, "dropped": dropped},
+        )
 
     async def _notify_client(
         self, client_id: str, body: bytes, content_type: str = wire.CONTENT_TYPE
@@ -600,15 +670,16 @@ class Experiment:
         self._record_history_and_checkpoint(reports, n_epoch)
 
     async def _end_round_secure(self) -> None:
-        """Secure-round finalization (server/secure.py step 3).
+        """Secure-round finalization — Bonawitz round 3 (Unmasking).
 
-        The manager can only use the cohort's modular sum: it adds the
-        masked uint64 uploads, cancels residual masks toward cohort
-        members that never reported (each reporter reveals only its
-        pairwise seed with the dropped client), dequantizes, and divides
-        by the reporters' total sample count. If a reporter disappears
-        during recovery the round is unrecoverable — it aborts and the
-        previous global params stand.
+        The manager can only use the cohort's modular sum. Every
+        reporter is asked ONCE for its share bundle under the round's
+        survivor/dropped partition; from ≥t shares each, the server
+        reconstructs (a) dropped members' mask keys — cancelling their
+        uncancelled pairwise masks — and (b) each reporter's self-mask
+        seed — removing PRG(b_i). Up to n−t reporters may fail to answer
+        and the round still unmasks; below the threshold it aborts and
+        the previous global params stand.
         """
         from baton_tpu.server import secure
 
@@ -620,7 +691,7 @@ class Experiment:
         ):
             return
         if self._secure_finalizing:
-            # a finalization is already past this guard and mid-reveal;
+            # a finalization is already past this guard and mid-unmask;
             # a second one (watchdog tick / explicit end_round during the
             # await window) must not consume the round out from under it
             return
@@ -635,40 +706,89 @@ class Experiment:
                 for cid, r in self.rounds.client_responses.items()
                 if r.get("masked")
             }
-            dropped = [c for c in sr["cohort"] if c not in reporters]
-            if not reporters:
+            dropped = sorted(c for c in sr["cohort"] if c not in reporters)
+            survivors = sorted(reporters)
+            if not reporters or len(survivors) < sr["t"]:
+                # below the Shamir threshold nothing can be unmasked
+                self.metrics.inc("secure_rounds_unrecoverable")
                 self.rounds.abort_round()
                 self._secure_round = None
                 return
             template = params_to_state_dict(self.params)
+            bundles = await asyncio.gather(
+                *[
+                    self._request_unmask(
+                        rid, sr["round_name"], survivors, dropped
+                    )
+                    for rid in survivors
+                ]
+            )
+            # collect shares by secret owner; x-indices were fixed at
+            # share time, so partial responses compose correctly
+            b_shares: Dict[str, Dict[int, int]] = {s: {} for s in survivors}
+            csk_shares: Dict[str, Dict[int, int]] = {d: {} for d in dropped}
+            for rid, bundle in zip(survivors, bundles):
+                if bundle is None:
+                    continue
+                try:
+                    x = int(bundle["x"])
+                    if x != sr["index"].get(rid):
+                        continue  # mislabeled shares would corrupt Lagrange
+                    for cid, h in dict(bundle.get("b_shares", {})).items():
+                        if cid in b_shares:
+                            b_shares[cid][x] = secure.share_from_hex(str(h))
+                    for cid, h in dict(bundle.get("csk_shares", {})).items():
+                        if cid in csk_shares:
+                            csk_shares[cid][x] = secure.share_from_hex(str(h))
+                except (KeyError, ValueError, TypeError):
+                    continue
+            t = sr["t"]
+            short = [
+                cid
+                for cid, shs in list(b_shares.items()) + list(csk_shares.items())
+                if len(shs) < t
+            ]
+            if short:
+                # too many unmask responders failed: below threshold for
+                # at least one secret — the sum cannot be opened
+                self.metrics.inc("secure_rounds_unrecoverable")
+                self.rounds.abort_round()
+                self._secure_round = None
+                return
             corrections = []
-            if dropped:
-                # one flat gather over every (dropped, reporter) pair —
-                # finalization latency is one reveal round-trip, not D
-                rids = list(reporters)
-                pairs = [(d, rid) for d in dropped for rid in rids]
-                seeds = await asyncio.gather(
-                    *[
-                        self._request_reveal(rid, sr["round_name"], d)
-                        for d, rid in pairs
-                    ]
+            for d in dropped:
+                c_sk = secure.shamir_reconstruct(
+                    dict(list(csk_shares[d].items())[:t])
                 )
-                if any(s is None for s in seeds):
-                    # a reporter died mid-recovery: masks toward it can
-                    # no longer be cancelled — the sum is unusable
+                seeds = {
+                    rid: secure.dh_shared_seed(
+                        c_sk, sr["c_pks"][rid], sr["round_name"]
+                    )
+                    for rid in survivors
+                }
+                corrections.append(
+                    secure.dropout_correction(d, seeds, template)
+                )
+            self_seeds = []
+            for s_cid in survivors:
+                b_int = secure.shamir_reconstruct(
+                    dict(list(b_shares[s_cid].items())[:t])
+                )
+                if b_int >> 256:
+                    # a corrupt share makes the interpolation land almost
+                    # surely outside the 256-bit seed range — the sum
+                    # cannot be opened correctly; abort, don't crash the
+                    # finalize task (which would lock the round forever)
                     self.metrics.inc("secure_rounds_unrecoverable")
                     self.rounds.abort_round()
                     self._secure_round = None
                     return
-                by_dropped: Dict[str, dict] = {d: {} for d in dropped}
-                for (d, rid), s in zip(pairs, seeds):
-                    by_dropped[d][rid] = s
-                corrections = [
-                    secure.dropout_correction(d, by_dropped[d], template)
-                    for d in dropped
-                ]
+                self_seeds.append(b_int.to_bytes(32, "big"))
+            corrections.append(
+                secure.self_mask_correction(self_seeds, template)
+            )
             if not self.rounds.in_progress or self.rounds.round_name != sr["round_name"]:
-                return  # round was aborted while reveals were in flight
+                return  # round was aborted while unmasking was in flight
             if dropped:
                 self.metrics.inc("secure_dropouts_recovered", len(dropped))
             n_epoch = (self.rounds.round_meta or {}).get("n_epoch", 0)

@@ -22,6 +22,7 @@ compiled into one XLA program.
 from __future__ import annotations
 
 import asyncio
+import secrets
 from typing import Callable, Optional, Tuple
 
 import aiohttp
@@ -84,23 +85,15 @@ class ExperimentWorker:
         self._register_lock = asyncio.Lock()
         self.__session: Optional[aiohttp.ClientSession] = None
 
-        # secure aggregation (server/secure.py): per-round DH state.
-        # {round_name: sk}; bounded to the two most recent rounds so a
-        # long-lived worker doesn't accumulate keys.
-        self._secure_sk: dict = {}
-        # {round_name: {"cohort": [...], "pks": {cid: int}, "scale_bits": n}}
-        self._secure_ctx: dict = {}
-        # reveal budget: refuse to treat more than this fraction of the
-        # cohort as "dropped" in one round — bounds how many clients a
-        # protocol-deviating manager could unmask via fake dropout claims
-        # (see secure.py threat model; full Bonawitz double-masking is
-        # the complete fix)
-        self.max_reveal_fraction = 1 / 3
-        self._revealed: dict = {}  # {round_name: set(dropped ids revealed)}
+        # secure aggregation (server/secure.py, Bonawitz double masking):
+        # one state dict per round_name, bounded to the two most recent
+        # rounds so a long-lived worker doesn't accumulate key material.
+        self._secure: dict = {}
 
         app.router.add_post(f"/{self.name}/round_start", self.handle_round_start)
         app.router.add_post(f"/{self.name}/secure_keys", self.handle_secure_keys)
-        app.router.add_get(f"/{self.name}/reveal", self.handle_reveal)
+        app.router.add_post(f"/{self.name}/secure_shares", self.handle_secure_shares)
+        app.router.add_post(f"/{self.name}/secure_unmask", self.handle_secure_unmask)
         if auto_register:
             app.on_startup.append(self._on_startup)
             app.on_cleanup.append(self._on_cleanup)
@@ -176,59 +169,157 @@ class ExperimentWorker:
             and request.query.get("key") == self.key
         )
 
+    def _secure_state(self, round_name: str):
+        return self._secure.get(round_name)
+
     async def handle_secure_keys(self, request: web.Request) -> web.Response:
-        """Round-setup key agreement: generate a fresh DH keypair for the
-        named round and return the public key (server/secure.py step 1)."""
+        """Bonawitz round 0 (AdvertiseKeys): generate the round's two DH
+        keypairs — ``c`` keys the pairwise masks, ``s`` keys the
+        encrypted share transport — and return both public keys."""
         if not self._check_manager_auth(request):
             return web.json_response({"err": "Wrong Client"}, status=404)
         if self.round_in_progress:
-            # Mid-round key exchange would rotate the sk a still-running
-            # round's upload will be masked with (aborted rounds REUSE
-            # round names — reference naming parity), producing masks no
-            # peer cancels. Refuse; the manager excludes us this round.
+            # Mid-round key rotation would orphan the still-running
+            # round's masks (aborted rounds REUSE round names — reference
+            # naming parity). Refuse; the manager excludes us this round.
             return web.json_response({"err": "Update in Progress"}, status=409)
         from baton_tpu.server import secure
 
         data = await request.json()
         round_name = str(data["round"])
-        sk, pk = secure.dh_keypair()
-        self._secure_sk[round_name] = sk
-        while len(self._secure_sk) > 2:  # keep current + previous round
-            self._secure_sk.pop(next(iter(self._secure_sk)))
-        while len(self._secure_ctx) > 2:
-            self._secure_ctx.pop(next(iter(self._secure_ctx)))
-        return web.json_response({"pk": f"{pk:x}"})
+        c_sk, c_pk = secure.dh_keypair()
+        s_sk, s_pk = secure.dh_keypair()
+        self._secure[round_name] = {
+            "c_sk": c_sk, "c_pk": c_pk, "s_sk": s_sk, "s_pk": s_pk,
+            "peer_shares": {}, "partition": None,
+        }
+        while len(self._secure) > 2:  # keep current + previous round
+            self._secure.pop(next(iter(self._secure)))
+        return web.json_response({"c_pk": f"{c_pk:x}", "s_pk": f"{s_pk:x}"})
 
-    async def handle_reveal(self, request: web.Request) -> web.Response:
-        """Dropout recovery: reveal this worker's pairwise seed with ONE
-        dropped cohort member (never a secret key, never a seed with a
-        live reporter — the manager only learns what it needs to cancel
-        the dropped client's residual masks)."""
+    async def handle_secure_shares(self, request: web.Request) -> web.Response:
+        """Bonawitz round 1 (ShareKeys): given the cohort's pk directory,
+        draw the self-mask seed b, Shamir-share b and the mask secret key
+        c_sk across the cohort, and return each peer's share pair sealed
+        under the pairwise share-transport key (the manager relays the
+        boxes but cannot open them)."""
         if not self._check_manager_auth(request):
             return web.json_response({"err": "Wrong Client"}, status=404)
         from baton_tpu.server import secure
 
-        round_name = request.query.get("round", "")
-        dropped = request.query.get("dropped", "")
-        sk = self._secure_sk.get(round_name)
-        ctx = self._secure_ctx.get(round_name)
-        if sk is None or ctx is None:
+        data = await request.json()
+        round_name = str(data["round"])
+        st = self._secure_state(round_name)
+        if st is None:
             return web.json_response({"err": "Unknown Round"}, status=410)
-        pk = ctx["pks"].get(dropped)
-        if pk is None or dropped == self.client_id:
-            return web.json_response({"err": "Unknown Client"}, status=400)
-        revealed = self._revealed.setdefault(round_name, set())
-        budget = max(1, int(len(ctx["cohort"]) * self.max_reveal_fraction))
-        if dropped not in revealed and len(revealed) >= budget:
-            # a manager claiming this many dropouts is either facing a
-            # catastrophic cohort failure or fabricating dropout claims
-            # to unmask clients — either way, refuse (the round aborts)
-            return web.json_response({"err": "Reveal Budget"}, status=429)
-        revealed.add(dropped)
-        while len(self._revealed) > 2:
-            self._revealed.pop(next(iter(self._revealed)))
-        seed = secure.dh_shared_seed(sk, pk, round_name)
-        return web.json_response({"seed": seed.hex()})
+        try:
+            pks = {
+                cid: (int(p["c"], 16), int(p["s"], 16))
+                for cid, p in data["pks"].items()
+            }
+            t = int(data["t"])
+        except (KeyError, ValueError, TypeError):
+            return web.json_response({"err": "Bad Payload"}, status=400)
+        cohort = sorted(pks)
+        if self.client_id not in cohort or not 1 <= t <= len(cohort):
+            return web.json_response({"err": "Bad Cohort"}, status=400)
+        if t < len(cohort) // 2 + 1:
+            # a low threshold is the t=1 unmask-everyone attack: with
+            # t=1 the server holds a reconstructing share of every b_i
+            # and c_sk_i by itself — refuse anything below honest majority
+            return web.json_response({"err": "Threshold Too Low"}, status=400)
+        index = {cid: x + 1 for x, cid in enumerate(cohort)}
+        b_seed = secrets.token_bytes(32)
+        b_shares = secure.shamir_share(
+            int.from_bytes(b_seed, "big"), len(cohort), t
+        )
+        csk_shares = secure.shamir_share(st["c_sk"], len(cohort), t)
+        boxes = {}
+        for cid in cohort:
+            if cid == self.client_id:
+                continue
+            # direction-bound key: without the sender->recipient context
+            # the pair's two boxes would share one nonce-free keystream
+            # (a two-time pad to the relaying server) and a reflected box
+            # would still authenticate
+            try:
+                key = secure.dh_shared_seed(
+                    st["s_sk"], pks[cid][1],
+                    f"{round_name}|shares|{self.client_id}>{cid}",
+                )
+            except ValueError:
+                continue  # Byzantine pk: skip this peer, not the round
+            plain = (
+                secure.share_to_hex(b_shares[index[cid]])
+                + secure.share_to_hex(csk_shares[index[cid]])
+            ).encode()
+            boxes[cid] = secure.seal(key, plain).hex()
+        st.update(
+            pks=pks, cohort=cohort, index=index, t=t, b=b_seed,
+            own_shares=(
+                b_shares[index[self.client_id]],
+                csk_shares[index[self.client_id]],
+            ),
+        )
+        return web.json_response({"shares": boxes})
+
+    async def handle_secure_unmask(self, request: web.Request) -> web.Response:
+        """Bonawitz round 3 (Unmasking): given the server's survivor/
+        dropped partition of the masking cohort, return — per peer —
+        EITHER its self-mask share (survivors) OR its mask-key share
+        (dropped), never both. The either-or rule plus partition pinning
+        is what makes a fabricated dropout claim useless: naming a live
+        reporter 'dropped' forfeits its self-mask share, so its upload
+        stays masked by PRG(b)."""
+        if not self._check_manager_auth(request):
+            return web.json_response({"err": "Wrong Client"}, status=404)
+        from baton_tpu.server import secure
+
+        data = await request.json()
+        round_name = str(data.get("round", ""))
+        st = self._secure_state(round_name)
+        if st is None or "cohort" not in st:
+            return web.json_response({"err": "Unknown Round"}, status=410)
+        survivors = sorted(map(str, data.get("survivors", [])))
+        dropped = sorted(map(str, data.get("dropped", [])))
+        cohort = set(st["cohort"])
+        part = (tuple(survivors), tuple(dropped))
+        if (
+            not set(survivors) <= cohort
+            or not set(dropped) <= cohort
+            or set(survivors) & set(dropped)
+            or self.client_id not in survivors
+            or len(survivors) < st["t"]
+        ):
+            # len(survivors) >= t also bounds fake-dropout claims: a
+            # partition dropping more than n-t members cannot
+            # reconstruct and is refused outright
+            return web.json_response({"err": "Bad Partition"}, status=400)
+        if st["partition"] is not None and st["partition"] != part:
+            # a second, DIFFERENT partition for the same round is the
+            # both-share-types extraction attack — refuse permanently
+            return web.json_response({"err": "Partition Pinned"}, status=409)
+        st["partition"] = part
+
+        b_shares = {}
+        csk_shares = {}
+        for cid in survivors:
+            if cid == self.client_id:
+                b_shares[cid] = secure.share_to_hex(st["own_shares"][0])
+            elif cid in st["peer_shares"]:
+                b_shares[cid] = secure.share_to_hex(
+                    st["peer_shares"][cid][0]
+                )
+        for cid in dropped:
+            if cid in st["peer_shares"]:
+                csk_shares[cid] = secure.share_to_hex(
+                    st["peer_shares"][cid][1]
+                )
+        return web.json_response({
+            "x": st["index"][self.client_id],
+            "b_shares": b_shares,
+            "csk_shares": csk_shares,
+        })
 
     # -- rounds --------------------------------------------------------
     async def handle_round_start(self, request: web.Request) -> web.Response:
@@ -254,16 +345,41 @@ class ExperimentWorker:
             return web.json_response({"err": "Bad Payload"}, status=400)
         secure_info = meta.get("secure")
         if secure_info is not None:
-            if round_name not in self._secure_sk:
-                # key agreement never happened for this round: we cannot
-                # produce a correctly-masked upload, and an unmasked one
-                # would poison the cohort's modular sum
+            st = self._secure.get(round_name)
+            if st is None or "cohort" not in st:
+                # key agreement / share distribution never happened for
+                # this round: we cannot produce a correctly-masked
+                # upload, and an unmasked one would poison the sum
                 return web.json_response({"err": "No Round Keys"}, status=400)
-            self._secure_ctx[round_name] = {
-                "cohort": list(secure_info["cohort"]),
-                "pks": {c: int(p, 16) for c, p in secure_info["pks"].items()},
-                "scale_bits": int(secure_info.get("scale_bits", 16)),
-            }
+            from baton_tpu.server import secure as _secure
+
+            mask_cohort = sorted(map(str, secure_info["cohort"]))
+            if (
+                not set(mask_cohort) <= set(st["cohort"])
+                or self.client_id not in mask_cohort
+            ):
+                return web.json_response({"err": "Bad Cohort"}, status=400)
+            st["mask_cohort"] = mask_cohort
+            st["scale_bits"] = int(secure_info.get("scale_bits", 16))
+            # decrypt the share boxes relayed via the manager; a box
+            # failing authentication just leaves that sender's shares
+            # missing (reconstruction needs only t of n)
+            for sender, ct_hex in dict(secure_info.get("inbox", {})).items():
+                if sender == self.client_id or sender not in st["pks"]:
+                    continue
+                try:
+                    key = _secure.dh_shared_seed(
+                        st["s_sk"], st["pks"][sender][1],
+                        f"{round_name}|shares|{sender}>{self.client_id}",
+                    )
+                    plain = _secure.unseal(key, bytes.fromhex(ct_hex)).decode()
+                    half = len(plain) // 2
+                    st["peer_shares"][sender] = (
+                        _secure.share_from_hex(plain[:half]),
+                        _secure.share_from_hex(plain[half:]),
+                    )
+                except (ValueError, UnicodeDecodeError):
+                    pass
         self.params = new_params
         self.last_update = round_name
         self.round_in_progress = True
@@ -306,18 +422,20 @@ class ExperimentWorker:
             "n_samples": int(n_samples),
             "loss_history": [float(x) for x in loss_history],
         }
-        ctx = self._secure_ctx.get(round_name)
-        if ctx is not None:
+        st = self._secure.get(round_name)
+        if st is not None and "mask_cohort" in st:
             # Secure round: upload sample-weighted quantized params plus
-            # every pairwise mask — the manager can only use the cohort
-            # sum (server/secure.py step 2). Weighting happens client-
-            # side because the server cannot scale a masked ring element.
+            # every pairwise mask and the self mask PRG(b) — the manager
+            # can only use the cohort sum (server/secure.py). Weighting
+            # happens client-side because the server cannot scale a
+            # masked ring element.
             from baton_tpu.server import secure
 
-            sk = self._secure_sk[round_name]
             seeds = {
-                other: secure.dh_shared_seed(sk, pk, round_name)
-                for other, pk in ctx["pks"].items()
+                other: secure.dh_shared_seed(
+                    st["c_sk"], st["pks"][other][0], round_name
+                )
+                for other in st["mask_cohort"]
                 if other != self.client_id
             }
             weighted = {
@@ -326,9 +444,10 @@ class ExperimentWorker:
             }
             body = wire.encode(
                 secure.mask_state_dict(
-                    weighted, self.client_id, seeds, ctx["scale_bits"]
+                    weighted, self.client_id, seeds, st["scale_bits"],
+                    self_seed=st["b"],
                 ),
-                dict(meta, secure=True, scale_bits=ctx["scale_bits"]),
+                dict(meta, secure=True, scale_bits=st["scale_bits"]),
             )
         else:
             body = wire.encode(params_to_state_dict(self.params), meta)

@@ -6,54 +6,56 @@ a Bonawitz-style protocol on top of the modular-masking primitives in
 :mod:`baton_tpu.ops.secure_agg`, so the manager only ever learns the
 *sum* of client updates:
 
-1. **Key agreement** — per round, every cohort member generates a
-   Diffie-Hellman keypair (RFC 3526 group 14, 2048-bit MODP) and sends
-   the public key to the manager (``POST /{name}/secure_keys``); the
-   manager broadcasts the cohort's public-key directory inside
-   ``round_start``. Each pair (i, j) then shares a seed
-   ``SHA-256(round_name ‖ DH(sk_i, pk_j))`` that the server cannot
+0. **AdvertiseKeys** — per round, every cohort member generates TWO
+   Diffie-Hellman keypairs (RFC 3526 group 14, 2048-bit MODP): ``c``
+   keys derive the pairwise mask seeds, ``s`` keys encrypt the share
+   transport (``POST /{name}/secure_keys``). Each pair (i, j) shares
+   seeds ``SHA-256(context ‖ DH(sk_i, pk_j))`` the server cannot
    compute.
-2. **Masked upload** — each client quantizes its sample-weighted update
-   into Z_2^64 (fixed point) and adds one Philox-derived uint64 mask
-   per pair: ``+mask`` when its client_id sorts before the peer's,
-   ``−mask`` otherwise. Any single upload is uniform noise to the
-   server; the modular sum over the full cohort is exactly the sum of
-   the quantized updates. The 64-bit ring (vs the 32-bit offline
-   primitive in ops/secure_agg.py) buys headroom for *sample-weighted*
-   sums: at 16 fractional bits, Σᵢ nᵢ·|θ| may reach 2^47 before
-   wrapping — ample for any real federation, where 2^15 (the 32-bit
-   budget) is overflowed by a single 40k-sample client.
-3. **Dropout recovery** — if cohort members vanish between key exchange
-   and upload, every reporter's upload still carries uncancelled masks
-   toward them. The manager asks each reporter to reveal its *pairwise
-   seed with the dropped client only* (``GET /{name}/reveal``), rebuilds
-   those masks, and cancels the residue. Reporters' own pairwise seeds
-   (and all secret keys) never leave the clients.
+1. **ShareKeys** — each member draws a self-mask seed b_i and
+   Shamir-shares (t-of-n, honest-majority t = ⌊n/2⌋+1) both b_i and
+   its mask secret key c_sk_i across the cohort
+   (``POST /{name}/secure_shares``). Share pairs travel sealed under
+   the pairwise s-key (encrypt-then-MAC) and are RELAYED by the
+   manager inside the ``round_start`` broadcast — opaque to it.
+   Members that fail this phase never distributed shares, so they are
+   excluded from the masking cohort outright.
+2. **MaskedInputCollection** — each client uploads its sample-weighted
+   update quantized into Z_2^64 (fixed point) plus one Philox-derived
+   uint64 mask per pair (``+`` when its client_id sorts first, ``−``
+   otherwise) plus its self mask PRG(b_i). Any single upload — even
+   with every pairwise seed known — is uniform noise without b_i. The
+   64-bit ring (vs the 32-bit offline primitive in ops/secure_agg.py)
+   buys headroom for sample-weighted sums: at 16 fractional bits,
+   Σᵢ nᵢ·|θ| may reach 2^47 before wrapping.
+3. **Unmasking** — the server partitions the masking cohort into
+   survivors (reporters) and dropped, and asks every reporter ONCE for
+   its share bundle (``POST /{name}/secure_unmask``): per peer, EITHER
+   the self-mask share (survivors) OR the mask-key share (dropped) —
+   never both, and the partition is pinned for the round. From ≥t
+   shares each, the server reconstructs dropped members' c_sk (to
+   cancel their residual pairwise masks) and survivors' b_i (to remove
+   self masks), then dequantizes the sum.
 
-Threat model — stated precisely, because it is narrower than full
-Bonawitz: the server is **honest-but-curious and follows the protocol**
-(it only requests reveals for clients that genuinely never reported),
-and clients do not collude with it. Under that model the server learns
-only the cohort sum. A server that *deviates* by falsely claiming a
-live reporter dropped can collect the other reporters' seeds toward it
-and unmask that one client's update; closing that hole requires the
-full protocol's double masking (per-client self-mask b_i) with Shamir
-shares so each peer reveals, per client, EITHER the pairwise seed OR
-the self-mask share — never both. Workers bound the damage of a
-deviating server with a per-round reveal budget
-(``max_reveal_fraction``): at most that fraction of the cohort can be
-named "dropped" before the worker refuses further reveals and the
-round aborts. A reporter that dies *during* recovery also makes the
-round unrecoverable; the manager then aborts and keeps the previous
-global params, which is safe. Round-binding the seed hash prevents
-cross-round mask replay.
+Threat model (Bonawitz et al. 2017, honest-but-curious single server,
+honest majority of clients): the server learns only the survivors'
+sum. Fabricated dropout claims are useless — naming a live reporter
+"dropped" forfeits its self-mask share under the either-or rule, so
+its upload stays masked by PRG(b_i); asking again with a different
+partition is refused (pinning). Up to n−t unmask responders may fail
+and the round still opens; below the threshold the manager aborts and
+the previous global params stand, which is safe. Round-binding every
+seed hash prevents cross-round mask replay. (Active network attackers
+impersonating the server/clients would additionally need a PKI for
+signed key advertisements — out of scope, as in the paper's
+semi-honest variant.)
 """
 
 from __future__ import annotations
 
 import hashlib
 import secrets
-from typing import Dict, Mapping, Sequence, Tuple
+from typing import Dict, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -127,6 +129,101 @@ def dh_shared_seed(sk: int, pk_other: int, context: str) -> bytes:
     ).digest()
 
 
+# ======================================================================
+# Shamir t-of-n secret sharing over GF(2^521 − 1)
+#
+# The double-masking protocol (Bonawitz et al. 2017) needs each client's
+# self-mask seed b_i and mask-DH secret key recoverable by the SERVER
+# from any t honest peers — but no fewer. 2^521 − 1 is a Mersenne prime
+# comfortably above both 256-bit seeds and 256-bit DH exponents, and
+# Python integers make the field arithmetic exact and dependency-free.
+
+SHAMIR_P = (1 << 521) - 1
+_SHARE_BYTES = 66  # ceil(521 / 8)
+
+
+def shamir_share(secret: int, n: int, t: int) -> Dict[int, int]:
+    """Split ``secret`` into n shares with threshold t (any t reconstruct,
+    t−1 reveal nothing). Returns {x: f(x)} for x = 1..n."""
+    if not 0 <= secret < SHAMIR_P:
+        raise ValueError("secret out of field range")
+    if not 1 <= t <= n:
+        raise ValueError(f"need 1 <= t <= n, got t={t}, n={n}")
+    coeffs = [secret] + [
+        secrets.randbelow(SHAMIR_P) for _ in range(t - 1)
+    ]
+    out = {}
+    for x in range(1, n + 1):
+        y = 0
+        for c in reversed(coeffs):  # Horner
+            y = (y * x + c) % SHAMIR_P
+        out[x] = y
+    return out
+
+
+def shamir_reconstruct(shares: Dict[int, int]) -> int:
+    """Lagrange interpolation at 0 — exact iff ≥ t shares are supplied
+    (fewer yields a uniformly wrong value, by design)."""
+    total = 0
+    xs = list(shares)
+    for xi in xs:
+        num, den = 1, 1
+        for xj in xs:
+            if xj == xi:
+                continue
+            num = (num * (-xj)) % SHAMIR_P
+            den = (den * (xi - xj)) % SHAMIR_P
+        total = (
+            total + shares[xi] * num * pow(den, SHAMIR_P - 2, SHAMIR_P)
+        ) % SHAMIR_P
+    return total
+
+
+def share_to_hex(y: int) -> str:
+    return y.to_bytes(_SHARE_BYTES, "big").hex()
+
+
+def share_from_hex(h: str) -> int:
+    return int.from_bytes(bytes.fromhex(h), "big")
+
+
+# ======================================================================
+# authenticated share transport (client→client, relayed via the server)
+#
+# Share pairs travel through the untrusted manager, so they are
+# encrypted+MACed under a key only the two endpoints can derive
+# (DH on the dedicated share-transport keypair). Stdlib-only AEAD:
+# SHA-256 counter-mode keystream + HMAC-SHA256 (encrypt-then-MAC).
+
+import hmac as _hmac
+
+
+def _keystream(key: bytes, n: int) -> bytes:
+    out = b""
+    ctr = 0
+    while len(out) < n:
+        out += hashlib.sha256(key + b"|ks|" + ctr.to_bytes(8, "big")).digest()
+        ctr += 1
+    return out[:n]
+
+
+def seal(key: bytes, plaintext: bytes) -> bytes:
+    ct = bytes(
+        a ^ b for a, b in zip(plaintext, _keystream(key, len(plaintext)))
+    )
+    tag = _hmac.new(key, b"|mac|" + ct, hashlib.sha256).digest()
+    return tag + ct
+
+
+def unseal(key: bytes, sealed: bytes) -> bytes:
+    """Raises ValueError on a forged/garbled box."""
+    tag, ct = sealed[:32], sealed[32:]
+    want = _hmac.new(key, b"|mac|" + ct, hashlib.sha256).digest()
+    if not _hmac.compare_digest(tag, want):
+        raise ValueError("share box failed authentication")
+    return bytes(a ^ b for a, b in zip(ct, _keystream(key, len(ct))))
+
+
 def _pair_sign(my_id: str, other_id: str) -> int:
     """Mask sign convention: the lexicographically-smaller client_id adds
     the pair's mask, the larger subtracts it — identical on every party
@@ -166,12 +263,17 @@ def mask_state_dict(
     my_id: str,
     pair_seeds: Mapping[str, bytes],
     scale_bits: int = DEFAULT_SCALE_BITS,
+    self_seed: Optional[bytes] = None,
 ) -> Dict[str, np.ndarray]:
-    """Client-side: quantize ``state`` and add every pairwise mask.
+    """Client-side: quantize ``state`` and add every pairwise mask, plus
+    (double-masking) the client's own self-mask PRG(b_i).
 
     ``pair_seeds`` maps each *other* cohort member's client_id to the DH
     seed shared with it. The result is uint64 ring elements — uniform
-    noise to anyone missing the seeds.
+    noise to anyone missing the seeds. With ``self_seed`` (the Bonawitz
+    b_i) the upload stays uniform noise EVEN to a server that somehow
+    learned every pairwise seed; b_i is only recoverable from t Shamir
+    shares held by the peers.
     """
     out = quantize64(state, scale_bits)
     for other_id, seed in pair_seeds.items():
@@ -182,7 +284,26 @@ def mask_state_dict(
                 out[k] = (out[k] + mask[k]).astype(np.uint64)
             else:
                 out[k] = (out[k] - mask[k]).astype(np.uint64)
+    if self_seed is not None:
+        mask = pair_mask(self_seed, out)
+        for k in out:
+            out[k] = (out[k] + mask[k]).astype(np.uint64)
     return out
+
+
+def self_mask_correction(
+    self_seeds: Sequence[bytes], template: Mapping[str, np.ndarray]
+) -> Dict[str, np.ndarray]:
+    """Server-side: the additive correction removing reporters' self
+    masks — the negated sum of PRG(b_i) over the reconstructed b_i."""
+    corr = {
+        k: np.zeros(np.shape(v), np.uint64) for k, v in template.items()
+    }
+    for seed in self_seeds:
+        mask = pair_mask(seed, template)
+        for k in corr:
+            corr[k] = (corr[k] - mask[k]).astype(np.uint64)
+    return corr
 
 
 def modular_sum(updates: Sequence[Mapping[str, np.ndarray]]) -> Dict[str, np.ndarray]:

@@ -232,7 +232,7 @@ def main() -> None:
                 return (time.perf_counter() - t) / iters * 1e3  # ms
 
             attn_bench = {}
-            for L in (512, 2048):
+            for L in (2048, 4096):
                 if remaining() < 25.0:
                     break
                 dense_ms = time_attn(dot_product_attention, L)

@@ -1,11 +1,14 @@
 """Secure aggregation as a wire protocol (server/secure.py).
 
 Offline layer: DH key agreement symmetry, pairwise-mask cancellation,
-dropout-correction algebra. HTTP layer: a real manager + 3 workers over
-sockets where the server only ever receives uint64-masked uploads, yet
-the aggregate equals plain weighted FedAvg — including a round where one
-cohort member silently drops after key exchange and the manager runs
-seed-reveal recovery with the survivors.
+dropout-correction algebra, Shamir thresholds, authenticated share
+boxes, the double-masking property. HTTP layer: a real manager + 3
+workers over sockets running the full Bonawitz flow (AdvertiseKeys →
+ShareKeys → masked uploads → Unmasking) where the server only ever
+receives uint64-masked uploads yet the aggregate equals plain weighted
+FedAvg — including a dropped cohort member (Shamir mask-key recovery)
+and two active attacks (fabricated dropout claim, sub-threshold
+partition), both refused by the workers.
 """
 
 import asyncio
@@ -140,6 +143,60 @@ def test_uint64_ring_survives_large_weighted_updates(nprng):
     expected = {k: states[0][k] + states[1][k] for k in states[0]}
     for k in total:
         np.testing.assert_allclose(total[k], expected[k], atol=1e-3)
+
+
+def test_shamir_threshold():
+    import secrets as pysecrets
+
+    sec = pysecrets.randbits(256)
+    shares = secure.shamir_share(sec, 7, 4)
+    # any 4 reconstruct
+    assert secure.shamir_reconstruct({x: shares[x] for x in (2, 3, 5, 7)}) == sec
+    assert secure.shamir_reconstruct({x: shares[x] for x in (1, 2, 3, 4)}) == sec
+    # 3 do not
+    assert secure.shamir_reconstruct({x: shares[x] for x in (1, 2, 3)}) != sec
+    # hex transport roundtrip
+    assert secure.share_from_hex(secure.share_to_hex(shares[1])) == shares[1]
+
+
+def test_seal_unseal_authenticated():
+    import secrets as pysecrets
+
+    key = pysecrets.token_bytes(32)
+    pt = pysecrets.token_bytes(180)
+    box = secure.seal(key, pt)
+    assert secure.unseal(key, box) == pt
+    import pytest
+
+    with pytest.raises(ValueError):
+        secure.unseal(key, box[:-1] + bytes([box[-1] ^ 1]))
+    with pytest.raises(ValueError):
+        secure.unseal(pysecrets.token_bytes(32), box)
+
+
+def test_self_mask_blocks_pairwise_only_unmasking(nprng):
+    """The double-masking property: even WITH every pairwise seed, a
+    single upload stays garbage until the self mask PRG(b) is removed."""
+    ids, seeds = _setup_cohort(2, "update_t_00003")
+    state = _toy_states(nprng, 1)[0]
+    import secrets as pysecrets
+
+    b = pysecrets.token_bytes(32)
+    masked = secure.mask_state_dict(state, ids[0], seeds[ids[0]], self_seed=b)
+    # strip the pairwise masks (attacker knows all seeds)
+    pair = secure.pair_mask(seeds[ids[0]][ids[1]], state)
+    stripped = {
+        k: (np.asarray(masked[k], np.uint64)
+            - (pair[k] if ids[0] < ids[1] else np.uint64(0))
+            + (pair[k] if ids[0] > ids[1] else np.uint64(0))).astype(np.uint64)
+        for k in masked
+    }
+    still_masked = secure.unmask_sum(stripped, [])
+    assert max(np.max(np.abs(still_masked[k] - state[k])) for k in state) > 1.0
+    # removing the self mask too recovers the plaintext
+    plain = secure.unmask_sum(stripped, [secure.self_mask_correction([b], state)])
+    for k in plain:
+        np.testing.assert_allclose(plain[k], state[k], atol=1e-3)
 
 
 # ----------------------------------------------------------------------
@@ -310,6 +367,106 @@ def test_secure_round_dropout_recovery_over_http():
 
         snap = exp.metrics.snapshot()
         assert snap["counters"].get("secure_dropouts_recovered") == 1.0
+
+        for r in runners:
+            await r.cleanup()
+
+    run(main())
+
+
+def test_fabricated_dropout_claim_is_refused():
+    """A deviating server naming a LIVE reporter 'dropped' must not be
+    able to unmask it: the worker's either-or rule hands out the
+    reporter's mask-key share only under a partition that also forfeits
+    its self-mask share, and a second, different partition is refused
+    (pinning)."""
+
+    async def main():
+        exp, workers, runners, mport = await _secure_federation(3)
+
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{mport}/securetest/start_round?n_epoch=1"
+            ) as resp:
+                assert resp.status == 200
+            for _ in range(400):
+                if not exp.rounds.in_progress:
+                    break
+                await asyncio.sleep(0.05)
+        assert not exp.rounds.in_progress  # honest round completed
+
+        # attack replay: the server now tries to extract BOTH share
+        # types for worker[0] from worker[1] for the finished round
+        victim = workers[0].client_id
+        helper = workers[1]
+        round_name = workers[1].last_update
+        cohort = sorted(w.client_id for w in workers)
+        honest = {"round": round_name,
+                  "survivors": cohort, "dropped": []}
+        lying = {"round": round_name,
+                 "survivors": sorted(set(cohort) - {victim}),
+                 "dropped": [victim]}
+        url = (
+            f"http://127.0.0.1:{helper.port}/securetest/secure_unmask"
+            f"?client_id={helper.client_id}&key={helper.key}"
+        )
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            # the honest partition was already pinned by the real
+            # finalization — the lying one must be refused outright
+            async with session.post(url, json=lying) as resp:
+                assert resp.status == 409  # partition pinned
+            # re-asking with the pinned partition is idempotent-OK
+            async with session.post(url, json=honest) as resp:
+                assert resp.status == 200
+                bundle = await resp.json()
+                # ...and contains NO mask-key share for anyone
+                assert bundle["csk_shares"] == {}
+
+        for r in runners:
+            await r.cleanup()
+
+    run(main())
+
+
+def test_unmask_rejects_sub_threshold_survivor_sets():
+    """Partitions claiming most of the cohort died cannot reconstruct
+    anything and are refused by every worker (survivors >= t)."""
+
+    async def main():
+        exp, workers, runners, mport = await _secure_federation(3)
+
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{mport}/securetest/start_round?n_epoch=1"
+            ) as resp:
+                assert resp.status == 200
+            for _ in range(400):
+                if not exp.rounds.in_progress:
+                    break
+                await asyncio.sleep(0.05)
+
+        helper = workers[1]
+        round_name = helper.last_update
+        cohort = sorted(w.client_id for w in workers)
+        # t = 3//2+1 = 2; claiming only the helper survived (1 < t)
+        greedy = {
+            "round": round_name,
+            "survivors": [helper.client_id],
+            "dropped": sorted(set(cohort) - {helper.client_id}),
+        }
+        url = (
+            f"http://127.0.0.1:{helper.port}/securetest/secure_unmask"
+            f"?client_id={helper.client_id}&key={helper.key}"
+        )
+        async with aiohttp.ClientSession() as session:
+            async with session.post(url, json=greedy) as resp:
+                assert resp.status == 400  # Bad Partition
 
         for r in runners:
             await r.cleanup()
