@@ -438,18 +438,7 @@ def flash_attention(
         )
         bias2d = bias.reshape(b, lk).astype(jnp.float32)
 
-    if interpret:
-        # CPU interpret mode: shrink blocks to the sequence so tiny test
-        # shapes don't pay 128-padding
-        block_q = min(block_q, _round_pow2(lq))
-        block_k = min(block_k, _round_pow2(lk))
-    else:
-        # Real TPU lowering: blocks appear as the minor dim of the lse/db
-        # tiles and the second-minor of the score tile, so keep them
-        # (8, 128)-tile aligned — never below 128. Short sequences are
-        # padded up to one block (padded keys carry -inf bias).
-        block_q = max(128, min(block_q, _round_pow2(lq)))
-        block_k = max(128, min(block_k, _round_pow2(lk)))
+    block_q, block_k = _pick_blocks(lq, lk, block_q, block_k, interpret)
     pad_q = (-lq) % block_q
     pad_k = (-lk) % block_k
     if pad_q:
@@ -472,6 +461,98 @@ def _round_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
+
+
+# ======================================================================
+# block-level entry points for sequence-parallel composition
+# (parallel/ring_attention.py::flash_ring_attention): one K/V block's
+# flash forward returning the normalized output AND the logsumexp (for
+# cross-block online combination), and the matching backward given the
+# GLOBAL out/lse — the standard ring-attention decomposition, where each
+# block's backward against full-softmax statistics yields exactly its
+# contribution to the global gradients.
+
+
+def _pick_blocks(lq, lk, block_q, block_k, interpret):
+    """Clamp requested block sizes to the sequence. Interpret mode (CPU
+    tests) shrinks to the pow2 sequence so tiny shapes don't pay
+    128-padding; real TPU lowering keeps blocks >= 128 — they appear as
+    the minor dim of the lse/db tiles and the second-minor of the score
+    tile, so they must stay (8, 128)-tile aligned (short sequences pad
+    up to one block, padded keys carrying -inf bias)."""
+    if interpret:
+        return (min(block_q, _round_pow2(lq)),
+                min(block_k, _round_pow2(lk)))
+    return (max(128, min(block_q, _round_pow2(lq))),
+            max(128, min(block_k, _round_pow2(lk))))
+
+
+def flash_block_fwd(q, k, v, bias2d, causal, block_q=512, block_k=1024,
+                    interpret=None):
+    """One block's flash forward: (out [B,Hq,Lq,D] normalized, lse
+    [B,Hq,Lq] fp32). ``bias2d`` is the per-key additive bias [B, Lk].
+    NOT differentiable — pair with :func:`flash_block_bwd` inside an
+    outer custom VJP."""
+    b, hq, lq, d = q.shape
+    lk = k.shape[2]
+    if interpret is None:
+        interpret = _default_interpret()
+    scale = d ** -0.5
+    block_q, block_k = _pick_blocks(lq, lk, block_q, block_k, interpret)
+    pad_q = (-lq) % block_q
+    pad_k = (-lk) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        bias2d = jnp.pad(bias2d, ((0, 0), (0, pad_k)),
+                         constant_values=NEG_INF)
+    out, lse = _fwd(q, k, v, bias2d.astype(jnp.float32), causal, scale,
+                    block_q, block_k, interpret)
+    if pad_q:
+        out = out[:, :, :lq, :]
+        lse = lse[:, :, :lq]
+    return out, lse
+
+
+def flash_block_bwd(q, k, v, bias2d, out, dout, lse, causal,
+                    block_q=512, block_k=1024, interpret=None):
+    """One block's flash backward against GLOBAL (out, lse): returns
+    (dq, dk, dv, dbias2d) — this block's exact contributions to the
+    global gradients."""
+    b, hq, lq, d = q.shape
+    lk = k.shape[2]
+    if interpret is None:
+        interpret = _default_interpret()
+    scale = d ** -0.5
+    block_q, block_k = _pick_blocks(lq, lk, block_q, block_k, interpret)
+    pad_q = (-lq) % block_q
+    pad_k = (-lk) % block_k
+    if pad_q:
+        padq = ((0, 0), (0, 0), (0, pad_q), (0, 0))
+        q = jnp.pad(q, padq)
+        out = jnp.pad(out, padq)
+        dout = jnp.pad(dout, padq)  # zero dout rows => zero grads
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        bias2d = jnp.pad(bias2d, ((0, 0), (0, pad_k)),
+                         constant_values=NEG_INF)
+    dq, dk, dv, dbias = _bwd_call(
+        q, k, v, bias2d.astype(jnp.float32), out, dout, lse,
+        causal, scale, block_q, block_k, interpret,
+    )
+    if pad_q:
+        dq = dq[:, :, :lq, :]
+    if pad_k:
+        dk = dk[:, :, : lk, :]
+        dv = dv[:, :, : lk, :]
+        dbias = dbias[:, :lk]
+    return (
+        dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), dbias
+    )
 
 
 def make_flash_attention_fn(block_q: int = 512, block_k: int = 1024,

@@ -12,6 +12,13 @@ sequence length, the TPU way:
   (max/sum-rescaling) recurrence. N steps, each overlapping a block
   matmul with a neighbor push; memory per device is O(L/N · L/N)
   scores, never the full L×L.
+* **Ring × flash** (:func:`flash_ring_attention`): the same ring, but
+  each shard's block math runs the Pallas flash kernel
+  (ops/flash_attention.py) — per-shard memory falls from the dense
+  [L/N × L/N] fp32 score block to the kernel's O(block), and the block
+  matmuls inherit its measured MXU speed. Differentiable via a
+  ring-level custom VJP that re-rotates K/V in the backward and runs
+  each block's flash backward against the global softmax statistics.
 * **Ulysses attention** (:func:`ulysses_attention`): two
   ``lax.all_to_all``s swap sequence-sharding for head-sharding, run
   dense local attention over the full sequence for H/N heads, and swap
@@ -160,6 +167,179 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
+# ======================================================================
+# ring × flash: the per-shard block math runs the Pallas flash kernel
+# (ops/flash_attention.py) instead of materializing the dense
+# [Lq/N, Lk/N] fp32 score block — per-shard memory drops to the flash
+# kernel's O(block) and the MXU block math inherits its measured speed.
+# Differentiation is a ring-level custom VJP: the forward saves only
+# (out, global lse); the backward re-rotates K/V and runs each block's
+# flash backward against the GLOBAL statistics — each such call yields
+# exactly that block's contribution to the global gradients, with dk/dv/
+# dbias accumulators riding the same ring back to their home shard.
+
+
+def _ring_combine(o, lse, blk_out, blk_lse):
+    """Online combination of two normalized partial softmax results over
+    disjoint key sets: (o, lse) ⊕ (blk_out, blk_lse)."""
+    lse_new = jnp.logaddexp(lse, blk_lse)
+    w_old = jnp.exp(lse - lse_new)[..., None]
+    w_new = jnp.exp(blk_lse - lse_new)[..., None]
+    return o * w_old + blk_out.astype(jnp.float32) * w_new, lse_new
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_ring(q, k, v, bias2d, axis_name, causal, block_q, block_k,
+                interpret):
+    out, _ = _flash_ring_fwd(q, k, v, bias2d, axis_name, causal,
+                             block_q, block_k, interpret)
+    return out
+
+
+def _flash_ring_fwd(q, k, v, bias2d, axis_name, causal, block_q, block_k,
+                    interpret):
+    from baton_tpu.ops.flash_attention import flash_block_fwd
+
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def varying(x):
+        return lax.pcast(x, (axis_name,), to="varying")
+
+    if bias2d is None:
+        bias2d = varying(jnp.zeros((q.shape[0], k.shape[2]), jnp.float32))
+
+    # peeled diagonal block: the only one needing intra-block causal
+    o0, lse0 = flash_block_fwd(q, k, v, bias2d, causal,
+                               block_q, block_k, interpret)
+    o = o0.astype(jnp.float32)
+    lse = lse0
+
+    def step(s, carry):
+        o, lse, k_cur, v_cur, b_cur = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        b_cur = lax.ppermute(b_cur, axis_name, perm)
+        src = (my - s) % n
+
+        def attend(carry):
+            o, lse = carry
+            blk_out, blk_lse = flash_block_fwd(
+                q, k_cur, v_cur, b_cur, False, block_q, block_k, interpret
+            )
+            return _ring_combine(o, lse, blk_out, blk_lse)
+
+        if causal:
+            # blocks from the future are fully masked: skip them
+            o, lse = lax.cond(src < my, attend, lambda c: c, (o, lse))
+        else:
+            o, lse = attend((o, lse))
+        return o, lse, k_cur, v_cur, b_cur
+
+    o, lse, _, _, _ = lax.fori_loop(1, n, step, (o, lse, k, v, bias2d))
+    return o.astype(q.dtype), lse
+
+
+def _flash_ring_save(q, k, v, bias2d, axis_name, causal, block_q, block_k,
+                     interpret):
+    out, lse = _flash_ring_fwd(q, k, v, bias2d, axis_name, causal,
+                               block_q, block_k, interpret)
+    return out, (q, k, v, bias2d, out, lse)
+
+
+def _flash_ring_bwd(axis_name, causal, block_q, block_k, interpret,
+                    res, dout):
+    from baton_tpu.ops.flash_attention import flash_block_bwd
+
+    q, k, v, bias2d, out, lse = res
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def varying(x):
+        return lax.pcast(x, (axis_name,), to="varying")
+
+    had_bias = bias2d is not None
+    if bias2d is None:
+        bias2d = varying(jnp.zeros((q.shape[0], k.shape[2]), jnp.float32))
+
+    # peeled diagonal block at home
+    dq, dk_acc, dv_acc, db_acc = flash_block_bwd(
+        q, k, v, bias2d, out, dout, lse, causal,
+        block_q, block_k, interpret,
+    )
+    dq = dq.astype(jnp.float32)
+    dk_acc = dk_acc.astype(jnp.float32)
+    dv_acc = dv_acc.astype(jnp.float32)
+
+    def step(s, carry):
+        dq, dk_acc, dv_acc, db_acc, k_cur, v_cur, b_cur = carry
+        # grads ride the ring WITH their K/V block, returning home after
+        # the final post-loop rotation
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        b_cur = lax.ppermute(b_cur, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+        db_acc = lax.ppermute(db_acc, axis_name, perm)
+        src = (my - s) % n
+
+        def attend(carry):
+            dq, dk_acc, dv_acc, db_acc = carry
+            bdq, bdk, bdv, bdb = flash_block_bwd(
+                q, k_cur, v_cur, b_cur, out, dout, lse, False,
+                block_q, block_k, interpret,
+            )
+            return (
+                dq + bdq.astype(jnp.float32),
+                dk_acc + bdk.astype(jnp.float32),
+                dv_acc + bdv.astype(jnp.float32),
+                db_acc + bdb,
+            )
+
+        if causal:
+            dq, dk_acc, dv_acc, db_acc = lax.cond(
+                src < my, attend, lambda c: c,
+                (dq, dk_acc, dv_acc, db_acc),
+            )
+        else:
+            dq, dk_acc, dv_acc, db_acc = attend(
+                (dq, dk_acc, dv_acc, db_acc)
+            )
+        return dq, dk_acc, dv_acc, db_acc, k_cur, v_cur, b_cur
+
+    dq, dk_acc, dv_acc, db_acc, _, _, _ = lax.fori_loop(
+        1, n, step, (dq, dk_acc, dv_acc, db_acc, k, v, bias2d)
+    )
+    # one final rotation brings each block's accumulated grads home
+    dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+    db_acc = lax.ppermute(db_acc, axis_name, perm)
+    return (
+        dq.astype(q.dtype),
+        dk_acc.astype(k.dtype),
+        dv_acc.astype(v.dtype),
+        db_acc.astype(res[3].dtype) if had_bias else None,
+    )
+
+
+_flash_ring.defvjp(_flash_ring_save, _flash_ring_bwd)
+
+
+def flash_ring_attention(q, k, v, axis_name: str = SEQ_AXIS,
+                         causal: bool = False, bias=None,
+                         block_q: int = 512, block_k: int = 1024,
+                         interpret=None):
+    """Exact ring attention whose per-shard block math is the Pallas
+    flash kernel. Call inside ``shard_map`` with q/k/v length-sharded
+    ([B, H, L/N, Dh] per device) and ``bias`` the per-shard [B, L/N]
+    additive key bias (or None). Differentiable (ring-level custom VJP).
+    """
+    return _flash_ring(q, k, v, bias, axis_name, causal,
+                       block_q, block_k, interpret)
+
+
 def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
                       causal: bool = False, bias=None):
     """Exact attention via head<->sequence all-to-all re-sharding.
@@ -197,21 +377,26 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
     return to_seq(out)
 
 
-def _seq_sharded_fn(kernel, mesh: Mesh, axis_name: str, with_bias: bool):
+def _seq_sharded_fn(kernel, mesh: Mesh, axis_name: str, with_bias: bool,
+                    check_vma: bool = True):
     spec = P(None, None, axis_name, None)
     bias_spec = P(None, axis_name)  # [B, L] key bias, sharded on L
 
+    # check_vma=False only for the flash-ring kernel: its embedded
+    # pallas_call out_shape structs carry no varying-manifest
+    # annotation; the dense ring/Ulysses kernels keep full VMA checking
     if with_bias:
         @partial(
             shard_map, mesh=mesh,
             in_specs=(spec, spec, spec, bias_spec), out_specs=spec,
+            check_vma=check_vma,
         )
         def sharded(q, k, v, bias2d):
             return kernel(q, k, v, bias=bias2d)
     else:
         @partial(
             shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=spec,
+            out_specs=spec, check_vma=check_vma,
         )
         def sharded(q, k, v):
             return kernel(q, k, v)
@@ -248,6 +433,35 @@ def make_ring_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
         kernel = partial(ring_attention, axis_name=axis_name, causal=causal)
         fn = _seq_sharded_fn(kernel, mesh, axis_name,
                              with_bias=bias is not None)
+        if bias is None:
+            return fn(q, k, v)
+        return fn(q, k, v, _check_seam_bias(bias, q.shape[0], k.shape[2]))
+
+    return attention_fn
+
+
+def make_flash_ring_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS,
+                                 block_q: int = 512, block_k: int = 1024,
+                                 interpret=None):
+    """An ``attention_fn`` for the model zoo backed by
+    :func:`flash_ring_attention`: sequence parallelism over
+    ``mesh[axis_name]`` with the Pallas flash kernel doing each shard's
+    block math — the long-context configuration for TPU (ICI ppermute
+    between shards, MXU flash blocks within them)."""
+
+    def attention_fn(q, k, v, bias=None, causal=False):
+        n = mesh.shape[axis_name]
+        if q.shape[2] % n:
+            raise ValueError(
+                f"ring attention needs sequence length divisible by mesh "
+                f"axis {axis_name!r} size {n}; got L={q.shape[2]}"
+            )
+        kernel = partial(
+            flash_ring_attention, axis_name=axis_name, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        fn = _seq_sharded_fn(kernel, mesh, axis_name,
+                             with_bias=bias is not None, check_vma=False)
         if bias is None:
             return fn(q, k, v)
         return fn(q, k, v, _check_seam_bias(bias, q.shape[0], k.shape[2]))

@@ -13,6 +13,13 @@ Two privacy layers compose:
   pairwise-cancelling noise, so the server only ever sees the SUM —
   demonstrated here by masking each client's round delta and checking
   the unmasked sum matches plain FedAvg.
+
+This recipe runs the *offline* masking primitives against a simulated
+cohort. For real multi-process federations, the HTTP control plane
+speaks the full Bonawitz double-masking protocol — key agreement,
+Shamir-shared self masks, threshold unmasking with dropout recovery —
+via ``Experiment(secure_agg=True)`` (baton_tpu/server/secure.py;
+driven end-to-end in tests/test_secure_http.py).
 """
 
 import argparse
