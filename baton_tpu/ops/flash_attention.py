@@ -438,21 +438,38 @@ def flash_attention(
         )
         bias2d = bias.reshape(b, lk).astype(jnp.float32)
 
-    block_q, block_k = _pick_blocks(lq, lk, block_q, block_k, interpret)
-    pad_q = (-lq) % block_q
-    pad_k = (-lk) % block_k
-    if pad_q:
-        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
-    if pad_k:
-        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-        bias2d = jnp.pad(bias2d, ((0, 0), (0, pad_k)),
-                         constant_values=NEG_INF)
+    block_q, block_k, pad_q, pad_k = _prepare_padding(
+        lq, lk, block_q, block_k, interpret
+    )
+    q = _pad_len(q, pad_q)
+    k, v = _pad_len(k, pad_k), _pad_len(v, pad_k)
+    bias2d = _pad_bias2d(bias2d, pad_k)
 
     out = _flash(q, k, v, bias2d, causal, scale, block_q, block_k, interpret)
     if pad_q:
         out = out[:, :, :lq, :]
     return out
+
+
+def _prepare_padding(lq, lk, block_q, block_k, interpret):
+    """Clamped blocks + the q/k pad amounts for them (shared by the
+    public kernel and the ring block entry points)."""
+    block_q, block_k = _pick_blocks(lq, lk, block_q, block_k, interpret)
+    return block_q, block_k, (-lq) % block_q, (-lk) % block_k
+
+
+def _pad_len(x, pad):
+    """Zero-pad the sequence axis (2) of a [B, H, L, D] tensor."""
+    if not pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+
+def _pad_bias2d(bias2d, pad):
+    """-inf-pad the key axis of a [B, L] bias: padded keys attend nothing."""
+    if not pad:
+        return bias2d
+    return jnp.pad(bias2d, ((0, 0), (0, pad)), constant_values=NEG_INF)
 
 
 def _round_pow2(n: int) -> int:
@@ -498,16 +515,12 @@ def flash_block_fwd(q, k, v, bias2d, causal, block_q=512, block_k=1024,
     if interpret is None:
         interpret = _default_interpret()
     scale = d ** -0.5
-    block_q, block_k = _pick_blocks(lq, lk, block_q, block_k, interpret)
-    pad_q = (-lq) % block_q
-    pad_k = (-lk) % block_k
-    if pad_q:
-        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
-    if pad_k:
-        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-        bias2d = jnp.pad(bias2d, ((0, 0), (0, pad_k)),
-                         constant_values=NEG_INF)
+    block_q, block_k, pad_q, pad_k = _prepare_padding(
+        lq, lk, block_q, block_k, interpret
+    )
+    q = _pad_len(q, pad_q)
+    k, v = _pad_len(k, pad_k), _pad_len(v, pad_k)
+    bias2d = _pad_bias2d(bias2d, pad_k)
     out, lse = _fwd(q, k, v, bias2d.astype(jnp.float32), causal, scale,
                     block_q, block_k, interpret)
     if pad_q:
@@ -526,20 +539,16 @@ def flash_block_bwd(q, k, v, bias2d, out, dout, lse, causal,
     if interpret is None:
         interpret = _default_interpret()
     scale = d ** -0.5
-    block_q, block_k = _pick_blocks(lq, lk, block_q, block_k, interpret)
-    pad_q = (-lq) % block_q
-    pad_k = (-lk) % block_k
+    block_q, block_k, pad_q, pad_k = _prepare_padding(
+        lq, lk, block_q, block_k, interpret
+    )
+    q = _pad_len(q, pad_q)
+    out = _pad_len(out, pad_q)
+    dout = _pad_len(dout, pad_q)  # zero dout rows => zero grads
     if pad_q:
-        padq = ((0, 0), (0, 0), (0, pad_q), (0, 0))
-        q = jnp.pad(q, padq)
-        out = jnp.pad(out, padq)
-        dout = jnp.pad(dout, padq)  # zero dout rows => zero grads
         lse = jnp.pad(lse, ((0, 0), (0, 0), (0, pad_q)))
-    if pad_k:
-        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-        bias2d = jnp.pad(bias2d, ((0, 0), (0, pad_k)),
-                         constant_values=NEG_INF)
+    k, v = _pad_len(k, pad_k), _pad_len(v, pad_k)
+    bias2d = _pad_bias2d(bias2d, pad_k)
     dq, dk, dv, dbias = _bwd_call(
         q, k, v, bias2d.astype(jnp.float32), out, dout, lse,
         causal, scale, block_q, block_k, interpret,

@@ -256,13 +256,23 @@ def test_flash_ring_grads_match_dense(nprng, causal):
 
 
 def test_flash_ring_bias_grads(nprng):
+    """Every cotangent in the biased ring backward — dq, the ring-homed
+    dk/dv accumulators, AND the bias cotangent itself (a rotation-count
+    bug would attribute a shard's db to the wrong shard)."""
     mesh = make_mesh(2, axis_names=("seq",))
     q, k, v = _qkv(nprng, hq=4, hkv=4, l=16)
     bias, _ = _ragged_bias(nprng, q.shape[0], 16)
     ring_fn = _flash_ring(mesh)
-    g_ring = jax.grad(lambda q: jnp.sum(ring_fn(q, k, v, bias=bias) ** 2))(q)
-    g_dense = jax.grad(
-        lambda q: jnp.sum(dot_product_attention(q, k, v, bias=bias) ** 2)
-    )(q)
-    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
-                               rtol=5e-4, atol=5e-5)
+
+    def loss(fn):
+        return lambda q, k, v, b: jnp.sum(fn(q, k, v, bias=b) ** 2)
+
+    g_ring = jax.grad(loss(ring_fn), argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g_dense = jax.grad(loss(dot_product_attention), argnums=(0, 1, 2, 3))(
+        q, k, v, bias
+    )
+    for gr, gd, name in zip(g_ring, g_dense, ("q", "k", "v", "bias")):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(gd), rtol=5e-4, atol=5e-5,
+            err_msg=f"d{name} mismatch",
+        )
