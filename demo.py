@@ -1,7 +1,13 @@
 """End-to-end demo — CLI parity with the reference demo (demo.py:62-77).
 
-  python demo.py manager <host> <port>
-  python demo.py worker  <manager-host:port> <port>
+  python demo.py manager <host> <port> [--secure] [--cpu]
+  python demo.py worker  <manager-host:port> <port> [--cpu]
+
+``--secure`` turns on Bonawitz double-masking secure aggregation
+(server/secure.py): workers upload masked tensors the manager cannot
+read individually; training behaves identically otherwise.
+``--cpu`` pins JAX to the host CPU — for smoke-testing the control
+plane without (or with a flaky) accelerator.
 
 Same shape as the reference: the manager hosts the "lineartest"
 experiment (a 10→1 linear regressor); each worker invents
@@ -16,28 +22,47 @@ Drive it exactly like the reference:
 
 import sys
 
-import numpy as np
-from aiohttp import web
-
-from baton_tpu.core.training import make_local_trainer
-from baton_tpu.data.synthetic import linear_client_data
-from baton_tpu.models.linear import linear_regression_model
-from baton_tpu.server.http_manager import Manager
-from baton_tpu.server.http_worker import ExperimentWorker
-
 
 def main() -> None:
-    if len(sys.argv) != 4 or sys.argv[1] not in ("manager", "worker"):
+    flags = {a for a in sys.argv[1:] if a.startswith("--")}
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    if (
+        len(args) != 3
+        or args[0] not in ("manager", "worker")
+        or not flags <= {"--secure", "--cpu"}
+        or (args[0] == "worker" and "--secure" in flags)  # manager-side flag:
+        # workers follow whatever protocol the round broadcast demands,
+        # so silently accepting it would mislead about what's masked
+    ):
         print(__doc__)
         raise SystemExit(1)
-    role, host, port = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    role, host, port = args[0], args[1], int(args[2])
+
+    if "--cpu" in flags:
+        # must precede the first backend touch; the environment may pin
+        # an accelerator platform via JAX_PLATFORMS, which jax.config
+        # outranks
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    from aiohttp import web
+
+    from baton_tpu.core.training import make_local_trainer
+    from baton_tpu.data.synthetic import linear_client_data
+    from baton_tpu.models.linear import linear_regression_model
+    from baton_tpu.server.http_manager import Manager
+    from baton_tpu.server.http_worker import ExperimentWorker
 
     model = linear_regression_model(10)  # name="lineartest"
     app = web.Application()
 
     if role == "manager":
         manager = Manager(app)
-        manager.register_experiment(model, round_timeout=600.0)
+        manager.register_experiment(
+            model, round_timeout=600.0, secure_agg="--secure" in flags
+        )
     else:
         nprng = np.random.default_rng()
 
