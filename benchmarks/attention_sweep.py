@@ -1,0 +1,63 @@
+"""Flash vs dense attention sweep — the measurements behind the
+default_attention dispatch policy (models/transformer.py) and the
+flash kernel's default block sizes (ops/flash_attention.py).
+
+Usage:  python benchmarks/attention_sweep.py [--lens 2048,4096] \
+            [--blocks 256x256,512x512,512x1024]
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+from baton_tpu.models.transformer import dot_product_attention
+from baton_tpu.ops.flash_attention import flash_attention
+
+
+def timeit(fn, L, b=4, h=8, d=64, iters=10):
+    kq, kk, kv = jax.random.split(jax.random.key(7), 3)
+    shape = (b, h, L, d)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+    # grad wrt ALL of q/k/v: differentiating only q would let XLA
+    # dead-code-eliminate dense attention's dk/dv contractions while the
+    # flash custom VJP always computes them — biasing the comparison
+    g = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(fn(q, k, v, causal=True).astype(jnp.float32)),
+        argnums=(0, 1, 2),
+    ))
+    jax.block_until_ready(g(q, k, v))  # compile
+    t = time.perf_counter()
+    for _ in range(iters):
+        out = g(q, k, v)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t) / iters * 1e3
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lens", default="2048,4096")
+    p.add_argument("--blocks", default="128x128,256x256,512x512,512x1024")
+    args = p.parse_args()
+    print(f"backend: {jax.default_backend()}")
+    for L in (int(x) for x in args.lens.split(",")):
+        d = timeit(dot_product_attention, L)
+        print(f"L={L} dense fwd+bwd {d:.2f} ms")
+        for spec in args.blocks.split(","):
+            bq, bk = (int(x) for x in spec.split("x"))
+            if bq > L or bk > L:
+                continue
+            f = timeit(
+                lambda q, k, v, **kw: flash_attention(
+                    q, k, v, block_q=bq, block_k=bk, **kw
+                ),
+                L,
+            )
+            print(f"  flash bq={bq} bk={bk}: {f:.2f} ms ({d / f:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()
