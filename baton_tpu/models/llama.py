@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from baton_tpu.core.model import FedModel
+from baton_tpu.models.moe import MoEConfig, moe_apply, moe_init
 from baton_tpu.models.transformer import (
     AttentionFn,
     dense_init,
@@ -59,6 +60,9 @@ class LlamaConfig:
     n_kv_heads: int = 8
     d_ff: int = 14336
     rope_theta: float = 500000.0
+    # Mixture-of-Experts: replaces every block's SwiGLU FFN with a
+    # routed expert layer (models/moe.py) — the ep axis
+    moe: Optional[MoEConfig] = None
 
     @property
     def head_dim(self) -> int:
@@ -87,6 +91,10 @@ def llama_lora_target(path: str, leaf) -> bool:
 
 def _block_init(key, cfg: LlamaConfig):
     ka, km = jax.random.split(key)
+    if cfg.moe is not None:
+        mlp = moe_init(km, cfg.d_model, cfg.d_ff, cfg.moe)
+    else:
+        mlp = swiglu_init(km, cfg.d_model, cfg.d_ff)
     return {
         "norm_attn": rms_init(cfg.d_model),
         "attn": mha_init(
@@ -94,17 +102,24 @@ def _block_init(key, cfg: LlamaConfig):
             out_std=cfg.d_model ** -0.5 / (2 * cfg.n_layers) ** 0.5,
         ),
         "norm_mlp": rms_init(cfg.d_model),
-        "mlp": swiglu_init(km, cfg.d_model, cfg.d_ff),
+        "mlp": mlp,
     }
 
 
 def _block_apply(p, x, cfg: LlamaConfig, rope, attention_fn: AttentionFn):
+    """Returns (x, aux); aux is the block's MoE load-balance loss (0.0
+    for dense blocks) — one output structure for both variants so the
+    remat wrapper and the layer loop don't branch."""
     x = x + mha_apply(
         p["attn"], rms_norm(x, p["norm_attn"]), cfg.n_heads,
         n_kv_heads=cfg.n_kv_heads, causal=True, rope=rope,
         attention_fn=attention_fn,
     )
-    return x + swiglu_apply(p["mlp"], rms_norm(x, p["norm_mlp"]))
+    h = rms_norm(x, p["norm_mlp"])
+    if cfg.moe is not None:
+        y, aux = moe_apply(p["mlp"], h, cfg.moe)
+        return x + y, aux
+    return x + swiglu_apply(p["mlp"], h), jnp.float32(0.0)
 
 
 def llama_lm_model(
@@ -132,8 +147,7 @@ def llama_lm_model(
             "lm_head": dense_init(keys[-1], cfg.d_model, cfg.vocab_size),
         }
 
-    def apply(params, batch, rng):
-        """Returns next-token logits [B, L, V] (fp32)."""
+    def _apply_with_aux(params, batch, rng):
         ids = batch["x"]
         l = ids.shape[1]
         rope = rope_angles(l, cfg.head_dim, cfg.rope_theta)
@@ -143,27 +157,45 @@ def llama_lm_model(
             if remat
             else _block_apply
         )
+        aux_total = jnp.float32(0.0)
         for blk in params["blocks"]:
-            x = block_fn(blk, x, cfg, rope, attention_fn)
+            x, aux = block_fn(blk, x, cfg, rope, attention_fn)
+            aux_total = aux_total + aux
         x = rms_norm(x, params["norm_f"])
         # bf16 operands, fp32 accumulation: the vocab projection is the
         # model's largest matmul — keep it on the fast MXU path
-        return jax.lax.dot_general(
+        logits = jax.lax.dot_general(
             x, params["lm_head"].astype(x.dtype),
             (((x.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        return logits, aux_total
+
+    def apply(params, batch, rng):
+        """Returns next-token logits [B, L, V] (fp32)."""
+        return _apply_with_aux(params, batch, rng)[0]
+
+    def _add_aux(per_example, aux):
+        # the MoE load-balance penalty is a whole-forward scalar; add it
+        # to EVERY example so the mean loss (what every consumer — the
+        # trainer objective, DP-SGD's per-example path, the evaluator —
+        # optimizes) gains exactly aux_weight·aux, independent of batch
+        # size
+        if cfg.moe is None:
+            return per_example
+        return per_example + cfg.moe.aux_weight * aux
 
     def per_example_loss(params, batch, rng):
-        logits = apply(params, batch, rng)
+        logits, aux = _apply_with_aux(params, batch, rng)
         tok_loss = per_token_cross_entropy(logits, batch["y"])  # [B, L]
         loss_mask = batch.get("loss_mask")
         if loss_mask is None:
-            return jnp.mean(tok_loss, axis=-1)
+            return _add_aux(jnp.mean(tok_loss, axis=-1), aux)
         m = loss_mask.astype(jnp.float32)
-        return jnp.sum(tok_loss * m, axis=-1) / jnp.maximum(
+        loss = jnp.sum(tok_loss * m, axis=-1) / jnp.maximum(
             jnp.sum(m, axis=-1), 1.0
         )
+        return _add_aux(loss, aux)
 
     return FedModel(init=init, apply=apply, per_example_loss=per_example_loss,
                     name=name, aux=cfg)

@@ -1,0 +1,164 @@
+"""Mixture-of-Experts FFN with expert parallelism — the ``ep`` axis.
+
+The reference has nothing remotely like this (its model zoo is a 10→1
+linear layer, reference demo.py:15-49); this layer exists so the
+decoder scales parameters past one chip the TPU way, completing the
+framework's parallelism axes (dp=clients, tp=model, sp=seq, ep=experts).
+
+TPU-first design:
+
+* **Static shapes throughout** — top-k routing uses the GShard/Switch
+  dispatch-tensor formulation: every expert gets a fixed capacity
+  ``C = ceil(capacity_factor · K · L / E)`` and tokens beyond it are
+  dropped (their gate mass is simply not added back — the residual
+  stream carries them unchanged). No dynamic shapes, so the whole layer
+  jits, vmaps over clients, and remats.
+* **Everything is einsum** — dispatch [B,S,E,C] · tokens [B,S,D] feeds
+  the stacked expert weights [E, D, F] in one batched contraction the
+  MXU tiles; combine is the transpose einsum weighted by the gates.
+  The dispatch tensor costs O(B·K·L·E·C) fp32 — fine for the
+  federated/long-context regimes this zoo targets; for trillion-scale
+  routing you would move to ragged all-to-all dispatch.
+* **Expert parallelism is a sharding annotation, not collectives** —
+  the stacked expert dim E is sharded over the ``model`` mesh axis
+  (parallel/tensor_parallel.py rules); GSPMD partitions the expert
+  einsums and inserts the all-to-alls. The router stays replicated.
+* **Load-balance aux loss** (Switch Transformer): ``E · Σ_e f_e · P_e``
+  where f_e is the fraction of tokens whose top-1 choice is e and P_e
+  the mean router probability — minimized (=1) at uniform routing.
+  :func:`baton_tpu.models.llama.llama_lm_model` folds it into the
+  per-example loss with ``moe.aux_weight``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from baton_tpu.models.transformer import dense_init
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+
+
+def moe_init(key, d_model: int, d_ff: int, cfg: MoEConfig):
+    kr, kg, ku, kd = jax.random.split(key, 4)
+    e = cfg.n_experts
+
+    def stack(k, d_in, d_out):
+        return jax.vmap(lambda kk: dense_init(kk, d_in, d_out))(
+            jax.random.split(k, e)
+        )
+
+    return {
+        "router": dense_init(kr, d_model, e),
+        "w_gate": stack(kg, d_model, d_ff),   # [E, D, F]
+        "w_up": stack(ku, d_model, d_ff),     # [E, D, F]
+        "w_down": stack(kd, d_ff, d_model),   # [E, F, D]
+    }
+
+
+def moe_capacity(cfg: MoEConfig, seq_len: int) -> int:
+    return max(
+        1, math.ceil(cfg.capacity_factor * cfg.top_k * seq_len / cfg.n_experts)
+    )
+
+
+def moe_apply(p, x, cfg: MoEConfig):
+    """x [B, L, D] -> (y [B, L, D] in x.dtype, aux fp32 scalar).
+
+    Routing math is fp32 regardless of compute dtype; the expert
+    matmuls keep x's dtype with fp32 accumulation (MXU bf16 path).
+    """
+    b, l, d = x.shape
+    e, k = cfg.n_experts, cfg.top_k
+    c = moe_capacity(cfg, l)
+
+    logits = jnp.einsum(
+        "bld,de->ble", x.astype(jnp.float32), p["router"]
+    )
+    probs = jax.nn.softmax(logits, axis=-1)                  # [B, L, E]
+    gate, idx = jax.lax.top_k(probs, k)                      # [B, L, K]
+    gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+
+    # flatten choices k-major (s = k·L + l): every token's 1st choice
+    # claims capacity before any token's 2nd choice — the Switch/GShard
+    # priority order
+    idx_f = jnp.swapaxes(idx, 1, 2).reshape(b, k * l)        # [B, S]
+    gate_f = jnp.swapaxes(gate, 1, 2).reshape(b, k * l)
+    mask = jax.nn.one_hot(idx_f, e, dtype=jnp.float32)       # [B, S, E]
+    pos = jnp.sum(
+        (jnp.cumsum(mask, axis=1) - 1.0) * mask, axis=-1
+    ).astype(jnp.int32)
+    keep = (pos < c).astype(jnp.float32)                     # [B, S]
+    disp = (
+        mask[..., None]
+        * jax.nn.one_hot(pos, c, dtype=jnp.float32)[:, :, None, :]
+        * keep[..., None, None]
+    )                                                        # [B, S, E, C]
+
+    # expose the k axis on the dispatch tensor instead of materializing
+    # k copies of x (s = k·L + l is k-major, so the reshape is exact)
+    disp_x = disp.astype(x.dtype)
+    expert_in = jnp.einsum(
+        "bklec,bld->becd", disp_x.reshape(b, k, l, e, c), x
+    )                                                        # [B, E, C, D]
+    h_gate = jnp.einsum(
+        "becd,edf->becf", expert_in, p["w_gate"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    h_up = jnp.einsum(
+        "becd,edf->becf", expert_in, p["w_up"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    h = (jax.nn.silu(h_gate) * h_up).astype(x.dtype)
+    expert_out = jnp.einsum(
+        "becf,efd->becd", h, p["w_down"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )                                                        # fp32
+
+    comb = disp * gate_f[..., None, None]                    # [B, S, E, C]
+    y = jnp.einsum("bsec,becd->bsd", comb, expert_out)       # fp32 [B, S, D]
+    y = y.reshape(b, k, l, d).sum(axis=1)                    # fold choices
+
+    # Switch load-balance aux over top-1 assignments
+    top1 = jax.nn.one_hot(idx[..., 0], e, dtype=jnp.float32)
+    frac_tokens = jnp.mean(top1, axis=(0, 1))                # [E]
+    mean_prob = jnp.mean(probs, axis=(0, 1))                 # [E]
+    aux = e * jnp.sum(frac_tokens * mean_prob)
+    return y.astype(x.dtype), aux
+
+
+def moe_dense_oracle(p, x, cfg: MoEConfig):
+    """Reference implementation with NO capacity dropping: every token
+    is processed by its top-k experts densely — what :func:`moe_apply`
+    must equal whenever capacity is ample (tests)."""
+    probs = jax.nn.softmax(
+        jnp.einsum("bld,de->ble", x.astype(jnp.float32), p["router"]),
+        axis=-1,
+    )
+    gate, idx = jax.lax.top_k(probs, cfg.top_k)
+    gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+
+    def ffn(xe, e):
+        g = jax.nn.silu(
+            xe.astype(jnp.float32) @ p["w_gate"][e].astype(jnp.float32)
+        )
+        u = xe.astype(jnp.float32) @ p["w_up"][e].astype(jnp.float32)
+        return (g * u) @ p["w_down"][e].astype(jnp.float32)
+
+    all_out = jnp.stack(
+        [ffn(x, e) for e in range(cfg.n_experts)], axis=2
+    )  # [B, L, E, D]
+    sel = jnp.take_along_axis(
+        all_out, idx[..., None], axis=2
+    )  # [B, L, K, D]
+    return jnp.sum(sel * gate[..., None], axis=2).astype(x.dtype)

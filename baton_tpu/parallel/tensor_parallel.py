@@ -55,6 +55,11 @@ def transformer_tp_spec(path: str, leaf, axis: str = MODEL_AXIS) -> P:
     (whose adapter leaves end in the same names under ``lora/``).
     """
     name = path.rsplit("/", 1)[-1]
+    if leaf.ndim == 3 and name in ("w_gate", "w_up", "w_down"):
+        # stacked MoE expert weights [E, D, F]: expert parallelism
+        # shards the expert dim; GSPMD partitions the routed einsums
+        # (models/moe.py) and inserts the dispatch collectives
+        return P(axis, None, None)
     if leaf.ndim == 2:
         if name in _COLUMN:
             return P(None, axis)
