@@ -98,11 +98,11 @@ def moe_apply(p, x, cfg: MoEConfig):
     pos = jnp.sum(
         (jnp.cumsum(mask, axis=1) - 1.0) * mask, axis=-1
     ).astype(jnp.int32)
-    keep = (pos < c).astype(jnp.float32)                     # [B, S]
+    # over-capacity slots (pos >= C) one_hot to an all-zero row — the
+    # token is dropped with no extra masking needed
     disp = (
         mask[..., None]
         * jax.nn.one_hot(pos, c, dtype=jnp.float32)[:, :, None, :]
-        * keep[..., None, None]
     )                                                        # [B, S, E, C]
 
     # expose the k axis on the dispatch tensor instead of materializing

@@ -98,7 +98,7 @@ def leaf_tp_sharding(
 def shard_params_tp(
     params: Params,
     mesh: Mesh,
-    spec_fn: Callable[[str, Any], P] = transformer_tp_spec,
+    spec_fn: Callable[[str, Any, str], P] = transformer_tp_spec,
     axis: str = MODEL_AXIS,
 ) -> Params:
     """Place a param tree on ``mesh`` with tensor-parallel shardings.
@@ -111,7 +111,7 @@ def shard_params_tp(
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     out = []
     for path, leaf in flat:
-        spec = spec_fn(path_str(path), leaf)
+        spec = spec_fn(path_str(path), leaf, axis)
         if spec != P() and not _divisible(leaf, spec, mesh):
             spec = P()
         out.append(jax.device_put(leaf, NamedSharding(mesh, spec)))

@@ -49,17 +49,20 @@ def test_moe_grads_match_dense_oracle(moe_params, nprng):
                                    rtol=1e-4, atol=1e-5)
 
 
-def test_moe_capacity_dropping_is_finite(moe_params, nprng):
-    p, _ = moe_params
-    tight = MoEConfig(n_experts=4, top_k=2, capacity_factor=0.25)
-    x = jnp.asarray(nprng.normal(size=(2, 16, 16)), jnp.float32)
-    assert moe_capacity(tight, 16) == 2
-    y, aux = moe_apply(p, x, tight)
-    assert np.all(np.isfinite(np.asarray(y)))
-    # dropped tokens contribute zero (residual carries them): the output
-    # norm under tight capacity can't exceed the undropped one
-    y_full, _ = moe_apply(p, x, MoEConfig(4, 2, 8.0))
-    assert float(jnp.sum(y ** 2)) <= float(jnp.sum(y_full ** 2)) + 1e-6
+def test_moe_capacity_dropping_zeroes_overflow(nprng):
+    """Deterministic overflow: route every token to expert 0 with
+    capacity 1 — exactly the first token is processed, the rest get an
+    exact zero (the residual stream carries them unchanged)."""
+    cfg = MoEConfig(n_experts=2, top_k=1, capacity_factor=0.1)
+    assert moe_capacity(cfg, 8) == 1
+    p = moe_init(jax.random.key(0), 16, 32, cfg)
+    # zero router => tied logits => lax.top_k deterministically picks
+    # expert 0 for every token
+    p = dict(p, router=jnp.zeros_like(p["router"]))
+    x = jnp.asarray(nprng.normal(size=(1, 8, 16)), jnp.float32)
+    y, _ = moe_apply(p, x, cfg)
+    assert float(jnp.sum(jnp.abs(y[0, 0]))) > 0.0
+    np.testing.assert_array_equal(np.asarray(y[0, 1:]), 0.0)
 
 
 def test_moe_capacity_formula():
