@@ -28,6 +28,7 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
+from jax.experimental import io_callback
 
 from baton_tpu.core.model import Batch, FedModel, Params, PRNGKey
 from baton_tpu.core.partition import ParamPartition
@@ -75,6 +76,15 @@ class LocalTrainer:
     # example-level DP-SGD (ops/privacy.py): per-example clipping +
     # Gaussian noise replace the plain batch gradient when set
     dp: Optional[DPConfig] = None
+    # Mid-training visibility (the reference streams tqdm batch progress
+    # and a running loss during local training, reference utils.py:70-91,
+    # demo.py:37-38; a jitted multi-epoch run is otherwise a black box).
+    # When set, ``progress_fn(epoch_index, epoch_loss)`` fires on the HOST
+    # after each epoch via ``jax.experimental.io_callback`` — the TPU-way
+    # equivalent of the reference's progress bar. Ordered, so it is for
+    # the single-client path (the HTTP worker, the manager's simulated
+    # cohort participant); leave unset under vmap/shard_map.
+    progress_fn: Optional[Callable[[int, float], None]] = None
 
     def init_opt_state(self, params: Params):
         return self.optimizer.init(params)
@@ -169,7 +179,8 @@ class LocalTrainer:
             )
             return (p, os, step_rng), (loss_sum, count)
 
-        def epoch_step(carry, epoch_rng):
+        def epoch_step(carry, xs):
+            epoch_rng, epoch_idx = xs
             p, os = carry
             perm_rng, step_rng = jax.random.split(epoch_rng)
             perm = jax.random.permutation(perm_rng, capacity)
@@ -187,11 +198,18 @@ class LocalTrainer:
             )
             total = jnp.maximum(jnp.sum(counts), 1.0)
             epoch_loss = jnp.sum(loss_sums) / total
+            if self.progress_fn is not None:
+                io_callback(
+                    self.progress_fn, None, epoch_idx, epoch_loss,
+                    ordered=True,
+                )
             return (p, os), epoch_loss
 
         epoch_rngs = jax.random.split(rng, n_epochs)
         (params, opt_state), loss_history = jax.lax.scan(
-            epoch_step, (params, opt_state), epoch_rngs
+            epoch_step,
+            (params, opt_state),
+            (epoch_rngs, jnp.arange(n_epochs, dtype=jnp.int32)),
         )
         return params, opt_state, loss_history
 
@@ -204,6 +222,7 @@ def make_local_trainer(
     regularizer: Optional[Regularizer] = None,
     partition: Optional[ParamPartition] = None,
     dp: Optional[DPConfig] = None,
+    progress_fn: Optional[Callable[[int, float], None]] = None,
 ) -> LocalTrainer:
     """Build a :class:`LocalTrainer`.
 
@@ -219,6 +238,7 @@ def make_local_trainer(
         regularizer=regularizer,
         partition=partition,
         dp=dp,
+        progress_fn=progress_fn,
     )
 
 

@@ -70,7 +70,13 @@ def bert_classifier_model(
     compute_dtype=jnp.float32,
     attention_fn: AttentionFn = default_attention,
     name: str = "bert_classifier",
+    remat: bool = False,
 ) -> FedModel:
+    """``remat=True`` wraps each encoder block in ``jax.checkpoint`` —
+    the backward pass recomputes block activations instead of storing
+    them, the same HBM/FLOPs trade the Llama decoder makes
+    (models/llama.py::llama_lm_model). Long-sequence FedProx fine-tunes
+    (config 3) use it to fit larger cohorts per wave."""
     cfg = config or BertConfig.base()
 
     def init(rng):
@@ -101,9 +107,14 @@ def bert_classifier_model(
         x = x.astype(compute_dtype)
         attn_mask = batch.get("attn_mask")
         bias = None if attn_mask is None else padding_bias(attn_mask)
+
+        def _block(blk, x, bias):
+            return prenorm_block_apply(blk, x, cfg.n_heads, bias=bias,
+                                       attention_fn=attention_fn)
+
+        block_fn = jax.checkpoint(_block) if remat else _block
         for blk in params["blocks"]:
-            x = prenorm_block_apply(blk, x, cfg.n_heads, bias=bias,
-                                    attention_fn=attention_fn)
+            x = block_fn(blk, x, bias)
         x = layer_norm(x, params["ln_f"])
         cls = x[:, 0, :].astype(jnp.float32)
         pooled = jnp.tanh(cls @ params["pooler"]["w"] + params["pooler"]["b"])

@@ -83,7 +83,13 @@ def vit_model(
     compute_dtype=jnp.float32,
     attention_fn: AttentionFn = default_attention,
     name: str = "vit",
+    remat: bool = False,
 ) -> FedModel:
+    """``remat=True`` wraps each encoder block in ``jax.checkpoint`` —
+    recompute-not-store for block activations, mirroring
+    models/llama.py::llama_lm_model. The DP cross-silo workload
+    (config 5) holds per-example grads for clipping, so activation HBM
+    is the binding constraint remat relieves."""
     cfg = config or ViTConfig.b16()
     patch_dim = cfg.patch * cfg.patch * cfg.channels
 
@@ -119,9 +125,13 @@ def vit_model(
             params["cls_token"].astype(x.dtype), (b, 1, cfg.d_model)
         )
         x = jnp.concatenate([cls, x], axis=1) + params["pos_emb"].astype(x.dtype)
+        def _block(blk, x):
+            return prenorm_block_apply(blk, x, cfg.n_heads,
+                                       attention_fn=attention_fn)
+
+        block_fn = jax.checkpoint(_block) if remat else _block
         for blk in params["blocks"]:
-            x = prenorm_block_apply(blk, x, cfg.n_heads,
-                                    attention_fn=attention_fn)
+            x = block_fn(blk, x)
         x = layer_norm(x, params["ln_f"])
         cls_out = x[:, 0, :].astype(jnp.float32)
         return cls_out @ params["head"]["w"] + params["head"]["b"]

@@ -13,6 +13,12 @@ train locally, POST the result to ``update`` — with the recorded fixes
 * Heartbeat backoff is capped exponential (reference doubled unboundedly,
   worker.py:78 ``# TODO: better backoff``).
 * Weights travel as BTW1 tensors, not pickles (pickle decode opt-in).
+* Mid-training visibility (reference utils.py:70-91 streams tqdm batch
+  progress + a running loss): the jitted multi-epoch run reports each
+  finished epoch from inside XLA via an ``io_callback`` progress hook
+  (core/training.py::LocalTrainer.progress_fn) into a :class:`Metrics`
+  registry served live at ``GET /{name}/metrics`` — gauges
+  ``train_epoch`` / ``train_epoch_loss`` update *during* the round.
 
 The training itself is the TPU path: a :class:`LocalTrainer` jitted
 multi-epoch run — the reference's Python epoch loop (demo.py:29-49)
@@ -22,6 +28,7 @@ compiled into one XLA program.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import secrets
 from typing import Callable, Optional, Tuple
 
@@ -36,6 +43,7 @@ from baton_tpu.ops.padding import pad_dataset, round_up
 from baton_tpu.server import wire
 from baton_tpu.server.state import params_to_state_dict, state_dict_to_params
 from baton_tpu.server.utils import PeriodicTask
+from baton_tpu.utils.metrics import Metrics
 
 GetData = Callable[[], Tuple[dict, int]]
 MAX_BACKOFF = 60.0
@@ -62,7 +70,17 @@ class ExperimentWorker:
     ):
         self.name = name or getattr(model, "name", "fedmodel")
         self.model = model
-        self.trainer = trainer or make_local_trainer(model)
+        self.metrics = Metrics()
+        trainer = trainer or make_local_trainer(model)
+        if trainer.progress_fn is None:
+            # per-epoch heartbeat out of the jitted run (module docstring);
+            # fires on the training thread — Metrics is threadsafe. The
+            # lambda resolves the hook per call, so it stays patchable.
+            trainer = dataclasses.replace(
+                trainer,
+                progress_fn=lambda i, l: self._on_epoch_progress(i, l),
+            )
+        self.trainer = trainer
         self.app = app
         self.port = port
         self.worker_host = worker_host
@@ -90,6 +108,7 @@ class ExperimentWorker:
         # rounds so a long-lived worker doesn't accumulate key material.
         self._secure: dict = {}
 
+        app.router.add_get(f"/{self.name}/metrics", self.handle_metrics)
         app.router.add_post(f"/{self.name}/round_start", self.handle_round_start)
         app.router.add_post(f"/{self.name}/secure_keys", self.handle_secure_keys)
         app.router.add_post(f"/{self.name}/secure_shares", self.handle_secure_shares)
@@ -386,7 +405,20 @@ class ExperimentWorker:
         asyncio.ensure_future(self._run_round(round_name, n_epoch))
         return web.json_response("OK")
 
+    def _on_epoch_progress(self, epoch_idx, epoch_loss) -> None:
+        """io_callback target: runs on the host after each jitted epoch."""
+        self.metrics.set_gauge("train_epoch", int(epoch_idx) + 1)
+        self.metrics.set_gauge("train_epoch_loss", float(epoch_loss))
+        self.metrics.inc("train_epochs_completed")
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(self.metrics.snapshot())
+
     async def _run_round(self, round_name: str, n_epoch: int) -> None:
+        # reset per-round progress so round N+1's zero-epochs state is
+        # distinguishable from round N's completion
+        self.metrics.set_gauge("train_epoch", 0)
+        self.metrics.set_gauge("train_epoch_loss", 0.0)
         try:
             data, n_samples = self.get_data()
             self.rng, sub = jax.random.split(self.rng)

@@ -1,0 +1,190 @@
+"""Wave-scheduling sweep under real HBM pressure (SURVEY §7 "hard parts").
+
+A 128-client ResNet-18/CIFAR cohort doesn't need waves for *compute* —
+one chip can vmap all 128 — but per-client params + optimizer state +
+activations scale linearly with the wave, so ``wave_size`` is the knob
+that trades peak HBM against dispatch overhead. This sweep measures that
+trade on the real chip: rounds/sec and peak HBM for wave_size ∈
+{16, 32, 64, 128}.
+
+Each setting runs in its OWN subprocess because
+``device.memory_stats()["peak_bytes_in_use"]`` is a high-water mark for
+the process lifetime — the only way to attribute a peak to one setting
+is process isolation.
+
+Usage:
+    python benchmarks/wave_sweep.py             # full sweep -> table +
+                                                # benchmarks/wave_sweep_tpu.json
+    python benchmarks/wave_sweep.py --wave 32   # one setting, one JSON line
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+N_CLIENTS = 128
+SAMPLES_PER_CLIENT = 48
+BATCH_SIZE = 32
+N_EPOCHS = 1
+WAVES = (16, 32, 64, 128)
+CHILD_TIMEOUT_S = 420.0
+
+
+def run_one(wave_size: int) -> dict:
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/baton_tpu_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from baton_tpu.models.resnet import resnet18_cifar_model
+    from baton_tpu.ops.padding import stack_client_datasets
+    from baton_tpu.parallel.engine import FedSim
+
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    datasets = [
+        {
+            "x": rng.normal(
+                size=(SAMPLES_PER_CLIENT, 32, 32, 3)
+            ).astype(np.float32),
+            "y": rng.integers(
+                0, 10, size=(SAMPLES_PER_CLIENT,)
+            ).astype(np.int32),
+        }
+        for _ in range(N_CLIENTS)
+    ]
+    data, n_samples = stack_client_datasets(datasets, batch_size=BATCH_SIZE)
+    data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    model = resnet18_cifar_model(compute_dtype=jnp.bfloat16)
+    params = model.init(jax.random.key(0))
+    sim = FedSim(model, batch_size=BATCH_SIZE, learning_rate=0.05)
+    key = jax.random.key(1)
+
+    t_c = time.perf_counter()
+    res = sim.run_round(params, data, n_samples, key, n_epochs=N_EPOCHS,
+                        wave_size=wave_size, collect_client_losses=False)
+    float(res.loss_history[-1])
+    compile_s = time.perf_counter() - t_c
+
+    iters = 8
+    p = res.params
+    t0 = time.perf_counter()
+    for i in range(iters):
+        res = sim.run_round(p, data, n_samples, jax.random.fold_in(key, i),
+                            n_epochs=N_EPOCHS, wave_size=wave_size,
+                            collect_client_losses=False)
+        p = res.params
+    float(res.loss_history[-1])
+    dt = time.perf_counter() - t0
+
+    stats = dev.memory_stats() or {}
+    peak = stats.get("peak_bytes_in_use", 0)
+    rec = {
+        "wave_size": wave_size,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "clients": N_CLIENTS,
+        "rounds_per_sec": round(iters / dt, 3),
+        "peak_hbm_gb": round(peak / 2**30, 3),
+        "compile_s": round(compile_s, 1),
+    }
+    if not peak:
+        # the axon-tunneled runtime may not surface allocator stats —
+        # keep whatever it DID report so a zero peak is diagnosable
+        rec["memory_stats_raw"] = {k: int(v) for k, v in stats.items()}
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--wave", type=int, default=None,
+                    help="run one setting and print its JSON line (child mode)")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "wave_sweep_tpu.json"))
+    args = ap.parse_args()
+
+    if args.wave is not None:
+        print(json.dumps(run_one(args.wave)))
+        return
+
+    results = []
+    for w in WAVES:
+        t0 = time.perf_counter()
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--wave", str(w)],
+                capture_output=True, text=True, timeout=CHILD_TIMEOUT_S,
+                env=env,
+            )
+        except subprocess.TimeoutExpired as e:
+            # a hung child must not discard the settings already measured
+            results.append({
+                "wave_size": w, "failed": "timeout",
+                "timeout_s": CHILD_TIMEOUT_S,
+                "wall_s": round(time.perf_counter() - t0, 1),
+            })
+            print(f"wave {w}: TIMEOUT after {CHILD_TIMEOUT_S:.0f}s",
+                  file=sys.stderr)
+            continue
+        if proc.returncode != 0:
+            # a failure IS a data point: full-cohort waves are expected to
+            # OOM — that memory wall is why wave scheduling exists
+            tail = proc.stderr.strip()[-2000:]
+            reason = "oom" if (
+                "RESOURCE_EXHAUSTED" in tail or "OOM" in tail
+                or "memory" in tail.lower()
+            ) else "error"
+            results.append({
+                "wave_size": w, "failed": reason,
+                "stderr_tail": tail[-600:],
+                "wall_s": round(time.perf_counter() - t0, 1),
+            })
+            print(f"wave {w}: FAILED ({reason})\n{tail}", file=sys.stderr)
+            continue
+        try:
+            rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            results.append({
+                "wave_size": w, "failed": "bad-output",
+                "stdout_tail": proc.stdout.strip()[-300:],
+                "wall_s": round(time.perf_counter() - t0, 1),
+            })
+            print(f"wave {w}: unparseable child output", file=sys.stderr)
+            continue
+        rec["wall_s"] = round(time.perf_counter() - t0, 1)
+        results.append(rec)
+        print(f"wave {w:4d}: {rec['rounds_per_sec']:6.3f} rounds/s  "
+              f"peak HBM {rec['peak_hbm_gb']:6.3f} GB  "
+              f"(compile {rec['compile_s']}s)", file=sys.stderr)
+
+    out = {
+        "config": {
+            "model": "resnet18_bf16", "clients": N_CLIENTS,
+            "samples_per_client": SAMPLES_PER_CLIENT,
+            "batch_size": BATCH_SIZE, "n_epochs": N_EPOCHS,
+        },
+        "results": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -300,3 +300,88 @@ def test_end_to_end_federation_two_workers():
             await r.cleanup()
 
     run(main())
+
+
+def test_worker_metrics_update_mid_round():
+    """Mid-training visibility (reference utils.py:70-91 tqdm parity):
+    the worker's GET /{name}/metrics must show per-epoch progress WHILE
+    the jitted multi-epoch run is still going, via the io_callback hook
+    (core/training.py::LocalTrainer.progress_fn)."""
+
+    async def main():
+        import time
+
+        model = linear_regression_model(10)
+        nprng = np.random.default_rng(5)
+        mport, wport = free_port(), free_port()
+
+        mapp = web.Application()
+        manager = Manager(mapp)
+        exp = manager.register_experiment(
+            model, name="lineartest", round_timeout=60.0
+        )
+        mrunner = web.AppRunner(mapp)
+        await mrunner.setup()
+        await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+        data = linear_client_data(nprng, min_batches=2, max_batches=3)
+        wapp = web.Application()
+        worker = ExperimentWorker(
+            wapp, model, f"127.0.0.1:{mport}", port=wport,
+            heartbeat_time=30.0,
+            trainer=make_local_trainer(model, batch_size=32, learning_rate=0.02),
+            get_data=lambda: (data, data["x"].shape[0]),
+        )
+        # hold the training thread briefly per epoch so the event loop
+        # provably interleaves polls with a running round
+        orig = worker._on_epoch_progress
+
+        def slowed(i, l):
+            orig(i, l)
+            time.sleep(0.03)
+
+        worker._on_epoch_progress = slowed
+        wrunner = web.AppRunner(wapp)
+        await wrunner.setup()
+        await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+
+        for _ in range(100):
+            if len(exp.registry) == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert len(exp.registry) == 1
+
+        n_epoch = 20
+        seen = []
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{mport}/lineartest/start_round"
+                f"?n_epoch={n_epoch}"
+            ) as resp:
+                assert resp.status == 200
+            for _ in range(2000):
+                async with session.get(
+                    f"http://127.0.0.1:{wport}/lineartest/metrics"
+                ) as resp:
+                    snap = await resp.json()
+                seen.append(snap["gauges"].get("train_epoch", 0))
+                if not exp.rounds.in_progress:
+                    break
+                await asyncio.sleep(0.01)
+        assert not exp.rounds.in_progress
+
+        # observed at least one PARTIAL state (0 < epoch < n_epoch) while
+        # the round ran, and the final state accounts for every epoch
+        assert any(0 < e < n_epoch for e in seen), seen
+        assert worker.metrics.snapshot()["gauges"]["train_epoch"] == n_epoch
+        assert (
+            worker.metrics.snapshot()["counters"]["train_epochs_completed"]
+            == n_epoch
+        )
+
+        await wrunner.cleanup()
+        await mrunner.cleanup()
+
+    run(main())

@@ -265,3 +265,49 @@ def test_bert_base_param_count():
     n = sum(int(np.prod(s.shape)) for s in jax.tree_util.tree_leaves(shapes))
     # BERT-base encoder ~110M minus the token-type table/tied head
     assert 100_000_000 < n < 115_000_000
+
+
+def _grad_allclose(model_a, model_b, params, batch):
+    """loss+grad equality between two builds of the same architecture."""
+    key = jax.random.key(2)
+
+    def loss(m):
+        return lambda p: m.per_example_loss(p, batch, key).mean()
+
+    l0, g0 = jax.value_and_grad(loss(model_a))(params)
+    l1, g1 = jax.value_and_grad(loss(model_b))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_bert_remat_matches_no_remat():
+    # remat is a pure scheduling choice: loss and grads must be identical
+    # (matches the Llama seam test, tests/test_hybrid_tp.py)
+    cfg = BertConfig.tiny()
+    params = bert_classifier_model(cfg).init(jax.random.key(0))
+    rng = np.random.default_rng(3)
+    batch = {
+        "x": jnp.asarray(rng.integers(0, cfg.vocab_size, (4, cfg.max_len)),
+                         jnp.int32),
+        "attn_mask": jnp.asarray(
+            rng.integers(0, 2, (4, cfg.max_len)), jnp.float32
+        ).at[:, 0].set(1.0),
+        "y": jnp.asarray(rng.integers(0, cfg.n_classes, (4,)), jnp.int32),
+    }
+    _grad_allclose(bert_classifier_model(cfg),
+                   bert_classifier_model(cfg, remat=True), params, batch)
+
+
+def test_vit_remat_matches_no_remat():
+    cfg = ViTConfig.tiny()
+    params = vit_model(cfg).init(jax.random.key(0))
+    rng = np.random.default_rng(4)
+    batch = {
+        "x": jnp.asarray(rng.normal(size=(
+            4, cfg.image_size, cfg.image_size, cfg.channels)), jnp.float32),
+        "y": jnp.asarray(rng.integers(0, cfg.n_classes, (4,)), jnp.int32),
+    }
+    _grad_allclose(vit_model(cfg), vit_model(cfg, remat=True), params, batch)
