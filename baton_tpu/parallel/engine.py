@@ -274,12 +274,22 @@ class FedSim:
         server_opt_state=None,
         client_indices: Optional[np.ndarray] = None,
         collect_client_losses: bool = True,
+        progress_fn=None,
     ) -> RoundResult:
         """Run one federated round; returns the new global params.
 
         ``client_indices`` selects a cohort (client sampling — the
         simulated analogue of only some registered clients acking a
         round, reference manager.py:87-92).
+
+        ``progress_fn(waves_done, n_waves)`` is the simulated-cohort
+        analogue of the worker's per-epoch hook (core/training.py):
+        called on the host after each wave's device work completes.
+        Costs a per-wave sync (blocks on the wave's loss scalar), so the
+        host stops dispatching ahead of the device — leave unset for
+        maximum-throughput runs, set it for long rounds that need
+        mid-round visibility (reference utils.py:70-91 streamed
+        progress; a multi-wave round is otherwise a black box).
         """
         params, frozen = self._split(params)
         n_samples = jnp.asarray(n_samples)
@@ -336,6 +346,9 @@ class FedSim:
             w_acc = wtot if w_acc is None else w_acc + wtot
             if per_client is not None:
                 per_client.append(closs[: stop - start])
+            if progress_fn is not None:
+                jax.block_until_ready(lsum)
+                progress_fn(start // wave_size + 1, -(-c // wave_size))
 
         denom = jnp.maximum(w_acc, 1e-9)
         aggregate = jax.tree_util.tree_map(

@@ -443,6 +443,10 @@ class Experiment:
             self._sim_task = asyncio.get_running_loop().create_task(
                 self._run_simulated(round_name, n_epoch)
             )
+            # the cohort is a participant like any other: report its ack
+            # in the start_round response (reference manager.py:87-89
+            # records acknowledging clients)
+            results = list(results) + [("__simulated__", True)]
 
         if self.rounds.in_progress and not len(self.rounds):
             self.rounds.abort_round()
@@ -562,7 +566,15 @@ class Experiment:
         like any other client."""
         args = self._sim_args
 
+        def on_wave(done: int, total: int) -> None:
+            # per-wave heartbeat (engine progress_fn): GET /{name}/metrics
+            # shows the cohort's position mid-round, mirroring the
+            # worker-side per-epoch hook (http_worker.py)
+            self.metrics.set_gauge("sim_wave", done)
+            self.metrics.set_gauge("sim_waves_total", total)
+
         def run():
+            self.metrics.set_gauge("sim_wave", 0)
             return self.simulator.run_round(
                 self.params,
                 args["data"],
@@ -571,6 +583,7 @@ class Experiment:
                 n_epochs=n_epoch,
                 wave_size=args["wave_size"],
                 collect_client_losses=False,
+                progress_fn=on_wave,
             )
 
         try:

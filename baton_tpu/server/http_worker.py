@@ -30,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import secrets
+import weakref
 from typing import Callable, Optional, Tuple
 
 import aiohttp
@@ -71,16 +72,16 @@ class ExperimentWorker:
         self.name = name or getattr(model, "name", "fedmodel")
         self.model = model
         self.metrics = Metrics()
-        trainer = trainer or make_local_trainer(model)
-        if trainer.progress_fn is None:
-            # per-epoch heartbeat out of the jitted run (module docstring);
-            # fires on the training thread — Metrics is threadsafe. The
-            # lambda resolves the hook per call, so it stays patchable.
-            trainer = dataclasses.replace(
-                trainer,
-                progress_fn=lambda i, l: self._on_epoch_progress(i, l),
-            )
-        self.trainer = trainer
+        if trainer is None:
+            # default trainer gets the per-epoch metrics heartbeat (module
+            # docstring). A USER-supplied trainer is kept verbatim: the
+            # trainer is a static jit-cache key (LocalTrainer.train,
+            # static_argnums=(0,)), so silently replacing it would break
+            # shared-trainer cache reuse across workers — call
+            # enable_progress_metrics() to opt a custom trainer in.
+            self.trainer = self._with_progress_hook(make_local_trainer(model))
+        else:
+            self.trainer = trainer
         self.app = app
         self.port = port
         self.worker_host = worker_host
@@ -404,6 +405,31 @@ class ExperimentWorker:
         self.round_in_progress = True
         asyncio.ensure_future(self._run_round(round_name, n_epoch))
         return web.json_response("OK")
+
+    def _with_progress_hook(self, trainer: LocalTrainer) -> LocalTrainer:
+        """Attach this worker's per-epoch metrics hook to ``trainer``.
+
+        The hook holds the worker only weakly: the jit cache keeps a
+        strong reference to the trainer (static argnum) for the process
+        lifetime, and a strongly-captured ``self`` would pin the worker
+        — params, dataset closure and all — long after app cleanup.
+        """
+        wref = weakref.ref(self)
+
+        def hook(epoch_idx, epoch_loss):
+            w = wref()
+            if w is not None:
+                # late-bound attribute lookup keeps the hook patchable
+                w._on_epoch_progress(epoch_idx, epoch_loss)
+
+        return dataclasses.replace(trainer, progress_fn=hook)
+
+    def enable_progress_metrics(self) -> None:
+        """Opt a user-supplied trainer into the per-epoch metrics
+        heartbeat. Note this makes the trainer unique to this worker —
+        one jit compile per worker instead of shared-trainer reuse."""
+        if self.trainer.progress_fn is None:
+            self.trainer = self._with_progress_hook(self.trainer)
 
     def _on_epoch_progress(self, epoch_idx, epoch_loss) -> None:
         """io_callback target: runs on the host after each jitted epoch."""

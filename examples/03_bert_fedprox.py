@@ -58,7 +58,7 @@ def make_data(rng, cfg, n_clients, n_per_client):
 
 def run(n_clients=8, n_per_client=24, n_rounds=3, n_epochs=2,
         batch_size=8, mu=0.1, config=None, seed=0,
-        real_data=False, data_dir=None):
+        real_data=False, data_dir=None, remat=False):
     cfg = config or BertConfig.tiny(n_classes=4)
     if real_data and cfg.vocab_size < 257:
         # byte-level tokenizer emits ids 0..256 (PAD=256); a smaller
@@ -77,7 +77,9 @@ def run(n_clients=8, n_per_client=24, n_rounds=3, n_epochs=2,
     data = {k: jnp.asarray(v) for k, v in data.items()}
     n_samples = jnp.asarray(n_samples)
 
-    model = bert_classifier_model(cfg)
+    # remat: recompute encoder-block activations in the backward pass —
+    # what lets long-sequence full-scale cohorts fit HBM (models/bert.py)
+    model = bert_classifier_model(cfg, remat=remat)
     sim = FedSim(model, batch_size=batch_size, learning_rate=5e-3,
                  regularizer=fedprox(mu=mu) if mu else None)
     params = sim.init(jax.random.key(seed))
@@ -97,14 +99,17 @@ if __name__ == "__main__":
     p.add_argument("--mu", type=float, default=0.1)
     p.add_argument("--data-dir", default=None,
                    help="directory holding AG-News train.csv/test.csv")
+    p.add_argument("--remat", action="store_true",
+                   help="recompute encoder activations in backward (fits "
+                        "bigger cohorts/sequences in HBM)")
     args = p.parse_args()
     if args.scale == "full":
         # byte-level vocab (257) needs vocab_size >= 257 on the model
         run(n_clients=64, n_per_client=1875, n_rounds=30, n_epochs=2,
             batch_size=32, mu=args.mu, real_data=True,
-            data_dir=args.data_dir,
+            data_dir=args.data_dir, remat=args.remat,
             config=BertConfig.base(n_classes=4, vocab_size=512))  # AG-News: 120k/64
     else:
         history, _ = run(mu=args.mu, real_data=bool(args.data_dir),
-                         data_dir=args.data_dir)
+                         data_dir=args.data_dir, remat=args.remat)
         assert history[-1] < history[0], "loss should fall"

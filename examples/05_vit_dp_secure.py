@@ -56,7 +56,7 @@ def make_data(rng, cfg, n_clients, n_per_client):
 
 def run(n_clients=4, n_per_client=16, n_rounds=2, n_epochs=1, batch_size=8,
         clip_norm=1.0, noise_multiplier=0.5, delta=1e-5, config=None,
-        seed=0):
+        seed=0, remat=False):
     cfg = config or ViTConfig.tiny()
     rng = np.random.default_rng(seed)
     data, n_samples = stack_client_datasets(
@@ -66,7 +66,10 @@ def run(n_clients=4, n_per_client=16, n_rounds=2, n_epochs=1, batch_size=8,
     n_samples = jnp.asarray(n_samples)
 
     dp = DPConfig(clip_norm=clip_norm, noise_multiplier=noise_multiplier)
-    model = vit_model(cfg)
+    # remat matters doubly under DP: per-example gradients multiply
+    # activation memory by the batch, so recompute-not-store is often
+    # the difference between fitting and OOM (models/vit.py)
+    model = vit_model(cfg, remat=remat)
     sim = FedSim(model, batch_size=batch_size, learning_rate=1e-2, dp=dp)
     params = sim.init(jax.random.key(seed))
 
@@ -129,10 +132,13 @@ def run(n_clients=4, n_per_client=16, n_rounds=2, n_epochs=1, batch_size=8,
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--scale", choices=["tiny", "full"], default="tiny")
+    p.add_argument("--remat", action="store_true",
+                   help="recompute encoder activations in backward (per-"
+                        "example DP grads make this the HBM lever)")
     args = p.parse_args()
     if args.scale == "full":
         run(n_clients=16, n_per_client=4096, n_rounds=20, batch_size=64,
-            config=ViTConfig.b16())
+            config=ViTConfig.b16(), remat=args.remat)
     else:
-        history, _ = run()
+        history, _ = run(remat=args.remat)
         assert np.isfinite(history[-1])

@@ -168,3 +168,16 @@ def test_server_optimizer_fedavg_identity(linear_setup):
     np.testing.assert_allclose(
         np.asarray(r1.params["w"]), np.asarray(r2.params["w"]), rtol=1e-5
     )
+
+
+def test_run_round_progress_fn_reports_each_wave(linear_setup):
+    """progress_fn (the simulated-cohort mid-round heartbeat) fires once
+    per completed wave with (waves_done, n_waves), in order."""
+    model, params, data, n_samples = linear_setup
+    sim = FedSim(model, batch_size=32, learning_rate=0.01)
+    calls = []
+    res = sim.run_round(params, data, n_samples, jax.random.key(3),
+                        n_epochs=1, wave_size=3,
+                        progress_fn=lambda d, t: calls.append((d, t)))
+    assert calls == [(1, 3), (2, 3), (3, 3)], calls
+    assert np.isfinite(float(res.loss_history[-1]))

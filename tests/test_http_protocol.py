@@ -332,6 +332,8 @@ def test_worker_metrics_update_mid_round():
             trainer=make_local_trainer(model, batch_size=32, learning_rate=0.02),
             get_data=lambda: (data, data["x"].shape[0]),
         )
+        # a user-supplied trainer keeps its jit identity; metrics are opt-in
+        worker.enable_progress_metrics()
         # hold the training thread briefly per epoch so the event loop
         # provably interleaves polls with a running round
         orig = worker._on_epoch_progress
@@ -383,5 +385,74 @@ def test_worker_metrics_update_mid_round():
 
         await wrunner.cleanup()
         await mrunner.cleanup()
+
+    run(main())
+
+
+def test_simulated_cohort_round_with_wave_progress():
+    """A manager with an attached FedSim cohort (attach_simulator) and no
+    real workers runs full rounds: the cohort participates as one
+    weighted client, and the per-wave heartbeat lands in the manager's
+    metrics (sim_wave == sim_waves_total when the round closes)."""
+
+    async def main():
+        import jax
+        import jax.numpy as jnp
+
+        from baton_tpu.ops.padding import stack_client_datasets
+        from baton_tpu.parallel.engine import FedSim
+
+        model = linear_regression_model(10)
+        nprng = np.random.default_rng(2)
+        datasets = [linear_client_data(nprng, min_batches=2, max_batches=2)
+                    for _ in range(6)]
+        data, n_samples = stack_client_datasets(datasets, batch_size=32)
+        data = {k: jnp.asarray(v) for k, v in data.items()}
+
+        mapp = web.Application()
+        manager = Manager(mapp)
+        exp = manager.register_experiment(
+            model, name="simtest", round_timeout=60.0,
+            start_background_tasks=False,
+        )
+        sim = FedSim(model, batch_size=32, learning_rate=0.02)
+        exp.attach_simulator(sim, data, n_samples, wave_size=2)
+
+        client = TestClient(TestServer(mapp))
+        await client.start_server()
+
+        resp = await client.get("/simtest/start_round?n_epoch=3")
+        assert resp.status == 200
+        acks = await resp.json()
+        assert acks == {"__simulated__": True}
+
+        for _ in range(400):
+            if not exp.rounds.in_progress:
+                break
+            await asyncio.sleep(0.05)
+        assert not exp.rounds.in_progress
+
+        resp = await client.get("/simtest/metrics")
+        snap = await resp.json()
+        # 6 clients / wave_size 2 = 3 waves, all reported
+        assert snap["gauges"]["sim_waves_total"] == 3
+        assert snap["gauges"]["sim_wave"] == 3
+
+        resp = await client.get("/simtest/loss_history")
+        hist = await resp.json()
+        assert len(hist) == 3 and all(np.isfinite(hist))
+
+        # the aggregate moved toward the data (the cohort actually trained)
+        resp = await client.get("/simtest/start_round?n_epoch=3")
+        assert resp.status == 200
+        for _ in range(400):
+            if not exp.rounds.in_progress:
+                break
+            await asyncio.sleep(0.05)
+        resp = await client.get("/simtest/loss_history")
+        hist2 = await resp.json()
+        assert len(hist2) == 6 and hist2[-1] < hist2[0]
+
+        await client.close()
 
     run(main())
