@@ -1,5 +1,6 @@
 from baton_tpu.data.synthetic import (
     linear_client_data,
+    synthetic_char_clients,
     synthetic_classification_clients,
 )
 from baton_tpu.data.partition import iid_partition, dirichlet_partition
@@ -13,6 +14,7 @@ from baton_tpu.data.datasets import (
 
 __all__ = [
     "linear_client_data",
+    "synthetic_char_clients",
     "synthetic_classification_clients",
     "iid_partition",
     "dirichlet_partition",

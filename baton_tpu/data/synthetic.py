@@ -76,3 +76,46 @@ def synthetic_image_clients(
         ).astype(np.float32)
         datasets.append({"x": x, "y": y})
     return datasets
+
+
+def synthetic_char_clients(
+    rng: np.random.Generator,
+    n_clients: int,
+    n_per_client: int = 32,
+    seq_len: int = 32,
+    vocab_size: int = 90,
+    order: int = 2,
+):
+    """Shakespeare-shaped non-IID char-LM shards (models/lstm.py).
+
+    Each client is a distinct "speaking role": its text is drawn from a
+    client-specific order-``order`` Markov chain over the character
+    alphabet, so clients share structure (a common base chain) but
+    differ in style (per-client perturbation) — the non-IID shape of
+    the FedAvg paper's role-per-client Shakespeare split. Sequences are
+    next-char pairs: ``y`` is ``x`` shifted by one.
+    """
+    base = rng.dirichlet(np.full(vocab_size, 0.3), size=vocab_size ** order)
+    datasets = []
+    for _ in range(n_clients):
+        style = rng.dirichlet(np.full(vocab_size, 0.5), size=vocab_size ** order)
+        probs = 0.7 * base + 0.3 * style
+        # per-state CDF once, then one searchsorted per char: rng.choice
+        # re-validates p on every call — tens of seconds at example 07's
+        # full scale (64 clients x ~20k chars)
+        cdf = np.cumsum(probs, axis=1)
+        uniforms = rng.random(n_per_client * seq_len + 1)
+        text_len = n_per_client * seq_len + 1
+        text = np.empty(text_len, np.int64)
+        text[:order] = rng.integers(0, vocab_size, order)
+        state = 0
+        for i in range(order):
+            state = state * vocab_size + int(text[i])
+        for i in range(order, text_len):
+            c = int(np.searchsorted(cdf[state], uniforms[i], side="right"))
+            text[i] = min(c, vocab_size - 1)
+            state = (state * vocab_size + int(text[i])) % (vocab_size ** order)
+        xs = text[: n_per_client * seq_len].reshape(n_per_client, seq_len)
+        ys = text[1: n_per_client * seq_len + 1].reshape(n_per_client, seq_len)
+        datasets.append({"x": xs.astype(np.int32), "y": ys.astype(np.int32)})
+    return datasets

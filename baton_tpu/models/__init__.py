@@ -5,6 +5,7 @@ from baton_tpu.models.resnet import resnet_model, resnet18_cifar_model
 from baton_tpu.models.lora import lora_wrap, lora_trainable, merge_lora
 from baton_tpu.models.bert import BertConfig, bert_classifier_model
 from baton_tpu.models.llama import LlamaConfig, llama_lm_model, llama_lora_target
+from baton_tpu.models.lstm import LSTMConfig, lstm_lm_model
 from baton_tpu.models.moe import MoEConfig, moe_apply, moe_init
 from baton_tpu.models.vit import ViTConfig, vit_model
 
@@ -22,6 +23,8 @@ __all__ = [
     "LlamaConfig",
     "llama_lm_model",
     "llama_lora_target",
+    "LSTMConfig",
+    "lstm_lm_model",
     "MoEConfig",
     "moe_apply",
     "moe_init",

@@ -574,7 +574,10 @@ class Experiment:
             self.metrics.set_gauge("sim_waves_total", total)
 
         def run():
+            # reset BOTH gauges: a stale total from the previous round
+            # would render "0 of <old total>" until the first wave lands
             self.metrics.set_gauge("sim_wave", 0)
+            self.metrics.set_gauge("sim_waves_total", 0)
             return self.simulator.run_round(
                 self.params,
                 args["data"],

@@ -23,6 +23,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 T0 = time.perf_counter()
@@ -384,8 +385,36 @@ def main() -> None:
     }))
 
 
+def _arm_watchdog() -> None:
+    """Last-resort liveness: if anything after a successful probe hangs
+    (observed: the tunneled TPU can stall indefinitely mid-compile after
+    a prior OOM), emit the error JSON line and hard-exit. A daemon timer
+    is immune to whatever is blocking the main thread in XLA; os._exit
+    skips atexit/XLA teardown, which is the point — teardown would hang
+    on the same dead tunnel."""
+
+    def fire():
+        log(f"WATCHDOG: exceeded budget {BUDGET_S:.0f}s + 120s grace; "
+            "accelerator presumed hung mid-run")
+        print(json.dumps({
+            "metric": "fedavg_rounds_per_sec_bench_error",
+            "value": 0.0,
+            "unit": "rounds/sec",
+            "vs_baseline": 0.0,
+            "unmeasured_metric":
+                "fedavg_rounds_per_sec_resnet18_cifar10_32clients_1chip",
+            "error": "watchdog: run hung past budget (accelerator stall)",
+        }), flush=True)
+        os._exit(0)
+
+    t = threading.Timer(BUDGET_S + 120.0, fire)
+    t.daemon = True
+    t.start()
+
+
 if __name__ == "__main__":
     try:
+        _arm_watchdog()
         main()
     except Exception as e:
         log(f"FATAL {type(e).__name__}: {e}")

@@ -70,7 +70,7 @@ def main() -> None:
             data = linear_client_data(nprng)
             return data, data["x"].shape[0]
 
-        ExperimentWorker(
+        worker = ExperimentWorker(
             app,
             model,
             manager=host,  # reference quirk kept: worker's 2nd arg is the manager address
@@ -78,6 +78,10 @@ def main() -> None:
             trainer=make_local_trainer(model, batch_size=32, learning_rate=0.001),
             get_data=get_data,
         )
+        # per-epoch progress at GET /{name}/metrics (user-supplied
+        # trainers don't get the hook automatically; one worker per
+        # process here, so a worker-unique trainer costs nothing)
+        worker.enable_progress_metrics()
 
     web.run_app(app, port=port)
 

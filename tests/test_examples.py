@@ -100,3 +100,12 @@ def test_cnn_mnist_fedavg_learns_to_target_accuracy():
     metrics = m.run(n_clients=4, n_rounds=8, n_epochs=2, n_per_client=64,
                     seed=7)
     assert metrics["accuracy"] > 0.9, metrics
+
+
+def test_lstm_shakespeare():
+    m = _load("07_lstm_shakespeare")
+    history, metrics = m.run(n_clients=4, n_rounds=3, n_epochs=2,
+                             n_per_client=8, seq_len=16)
+    # learns below next-char chance (log V) on Markov text
+    assert history[-1] < history[0]
+    assert np.isfinite(metrics["loss"])
