@@ -271,7 +271,20 @@ class Experiment:
             # validate at the door: a missing/mis-shaped tensor must be
             # rejected now, not crash aggregation after the round state
             # is consumed (which would discard every client's work)
-            if self.secure_agg:
+            compressed_anchor = None
+            if meta.get("compressed"):
+                if self.secure_agg:
+                    # a sparse support set leaks which coordinates moved;
+                    # masking needs dense ring elements (ops/compression.py)
+                    return web.json_response(
+                        {"err": "Compressed Upload In Secure Round"},
+                        status=400,
+                    )
+                # one device-to-host materialization per upload, shared
+                # by validation and reconstruction below
+                compressed_anchor = params_to_state_dict(self.params)
+                self._validate_compressed_upload(tensors, compressed_anchor)
+            elif self.secure_agg:
                 self._validate_masked_upload(tensors, meta)
             else:
                 state_dict_to_params(self.params, tensors)
@@ -294,6 +307,13 @@ class Experiment:
             # aborted attempt that reuses this round name) — folding it
             # in would add uncancellable mask noise
             return web.json_response({"error": "Not In Cohort"}, status=410)
+        if compressed_anchor is not None:
+            # reconstruct AFTER the round checks: the anchor (this
+            # round's broadcast == self.params, unchanged until
+            # end_round) is only right for the current round; stale
+            # uploads were already 410'd above
+            tensors = self._decompress_upload(tensors, compressed_anchor)
+            self.metrics.inc("compressed_updates_received")
         self.rounds.client_end(
             client_id,
             {
@@ -307,6 +327,48 @@ class Experiment:
         self.metrics.inc("updates_received")
         self._maybe_finish()
         return web.json_response("OK")
+
+    def _validate_compressed_upload(self, tensors, anchor) -> None:
+        """Structural check for a "<name>@idx"/"<name>@val" sparse-delta
+        upload (ops/compression.py wire layout): every model tensor
+        present, indices int / unique / in range, val/idx lengths equal,
+        any "@scale" a single finite value. Everything that could crash
+        or poison :meth:`_decompress_upload` is rejected HERE (400), not
+        after the round state is consumed."""
+        for k, ref in anchor.items():
+            idx = np.asarray(tensors[f"{k}@idx"])
+            val = np.asarray(tensors[f"{k}@val"])
+            size = int(np.size(np.asarray(ref)))
+            if idx.ndim != 1 or val.shape != idx.shape:
+                raise ValueError(f"bad sparse layout for {k}")
+            if not np.issubdtype(idx.dtype, np.integer):
+                raise ValueError(f"non-integer indices for {k}")
+            if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= size):
+                raise ValueError(f"index out of range for {k}")
+            if np.unique(idx).size != idx.size:
+                # duplicate indices silently drop delta mass in the
+                # scatter (dense[idx] = val keeps only the last write)
+                raise ValueError(f"duplicate indices for {k}")
+            if f"{k}@scale" in tensors:
+                scale = np.asarray(tensors[f"{k}@scale"]).ravel()
+                if scale.size != 1 or not np.isfinite(scale[0]):
+                    raise ValueError(f"bad scale for {k}")
+            if not np.all(np.isfinite(np.asarray(val, np.float64))):
+                raise ValueError(f"non-finite values for {k}")
+
+    def _decompress_upload(self, tensors, anchor) -> dict:
+        """anchor + sparse delta -> dense state_dict (float32)."""
+        out = {}
+        for k, ref in anchor.items():
+            idx = np.asarray(tensors[f"{k}@idx"])
+            val = np.asarray(tensors[f"{k}@val"], np.float32)
+            if f"{k}@scale" in tensors:
+                val = val * float(np.asarray(tensors[f"{k}@scale"]).ravel()[0])
+            ref = np.asarray(ref, np.float32)
+            dense = np.zeros(ref.size, np.float32)
+            dense[idx] = val
+            out[k] = ref + dense.reshape(ref.shape)
+        return out
 
     # ------------------------------------------------------------------
     def attach_simulator(self, sim, data, n_samples, wave_size=None) -> None:

@@ -50,6 +50,30 @@ GetData = Callable[[], Tuple[dict, int]]
 MAX_BACKOFF = 60.0
 
 
+def _parse_compress(spec: Optional[str]):
+    """``"topk:<frac>[:q8|q16]"`` -> ErrorFeedbackCompressor, else None."""
+    if spec is None:
+        return None
+    from baton_tpu.ops.compression import ErrorFeedbackCompressor
+
+    parts = spec.split(":")
+    if parts[0] != "topk" or len(parts) not in (2, 3):
+        raise ValueError(
+            f"unknown compress spec {spec!r}; expected 'topk:<frac>[:q8|q16]'"
+        )
+    frac = float(parts[1])
+    if not (0.0 < frac <= 1.0):
+        # fail at construction: inside the round task this would only
+        # surface as a permanent silent straggler
+        raise ValueError(f"compress fraction must be in (0, 1], got {frac}")
+    bits = None
+    if len(parts) == 3:
+        if parts[2] not in ("q8", "q16"):
+            raise ValueError(f"unknown quantizer {parts[2]!r} in {spec!r}")
+        bits = int(parts[2][1:])
+    return ErrorFeedbackCompressor(frac=frac, bits=bits)
+
+
 class ExperimentWorker:
     """Subclass and implement ``get_data() -> (data_dict, n_samples)``
     (reference worker.py:126-127), or pass ``get_data=`` callable."""
@@ -68,7 +92,13 @@ class ExperimentWorker:
         allow_pickle: bool = False,
         rng_seed: int = 0,
         auto_register: bool = True,
+        compress: Optional[str] = None,
     ):
+        """``compress`` turns on sparse round-delta uploads
+        (ops/compression.py): ``"topk:0.05"`` keeps the top 5% of delta
+        coordinates per tensor with error feedback across rounds;
+        ``"topk:0.05:q8"`` additionally quantizes kept values to int8.
+        Ignored for secure rounds (masking needs dense ring elements)."""
         self.name = name or getattr(model, "name", "fedmodel")
         self.model = model
         self.metrics = Metrics()
@@ -88,6 +118,8 @@ class ExperimentWorker:
         self.manager = manager
         self.manager_url = f"http://{manager}/{self.name}/"
         self.allow_pickle = allow_pickle
+        self.compressor = _parse_compress(compress)
+        self._round_anchor: Optional[dict] = None
         if get_data is not None:
             self.get_data = get_data  # type: ignore[assignment]
 
@@ -401,6 +433,14 @@ class ExperimentWorker:
                 except (ValueError, UnicodeDecodeError):
                     pass
         self.params = new_params
+        # the broadcast is this round's delta anchor: the manager holds
+        # the identical tensors until end_round, so `anchor + delta`
+        # reconstructs exactly server-side (ops/compression.py docstring)
+        if self.compressor is not None:
+            self._round_anchor = {
+                k: np.asarray(v, np.float32)
+                for k, v in params_to_state_dict(new_params).items()
+            }
         self.last_update = round_name
         self.round_in_progress = True
         asyncio.ensure_future(self._run_round(round_name, n_epoch))
@@ -481,6 +521,7 @@ class ExperimentWorker:
             "loss_history": [float(x) for x in loss_history],
         }
         st = self._secure.get(round_name)
+        compressed_payload = None  # set only on the compressed branch
         if st is not None and "mask_cohort" in st:
             # Secure round: upload sample-weighted quantized params plus
             # every pairwise mask and the self mask PRG(b) — the manager
@@ -507,20 +548,51 @@ class ExperimentWorker:
                 ),
                 dict(meta, secure=True, scale_bits=st["scale_bits"]),
             )
+        elif self.compressor is not None and self._round_anchor is not None:
+            # sparse round delta (ops/compression.py): top-k of
+            # (trained - broadcast) with error feedback; flat wire layout
+            # "<name>@idx"/"<name>@val" (+"@scale" when quantized)
+            sd = params_to_state_dict(self.params)
+            delta = {
+                k: np.asarray(v, np.float32) - self._round_anchor[k]
+                for k, v in sd.items()
+            }
+            compressed_payload = self.compressor.compress(delta)
+            compressed_template = delta
+            tensors = {}
+            for k, p in compressed_payload.items():
+                tensors[f"{k}@idx"] = np.asarray(p["idx"], np.int32)
+                val = p["val"]
+                if isinstance(val, dict):  # quantized {"q", "scale"}
+                    tensors[f"{k}@val"] = np.asarray(val["q"])
+                    tensors[f"{k}@scale"] = np.asarray(
+                        [float(val["scale"])], np.float32
+                    )
+                else:
+                    tensors[f"{k}@val"] = np.asarray(val, np.float32)
+            body = wire.encode(
+                tensors, dict(meta, compressed={"scheme": "topk"})
+            )
         else:
             body = wire.encode(params_to_state_dict(self.params), meta)
+        delivered = False
         try:
             async with self._session.post(
                 url, data=body, headers={"Content-Type": wire.CONTENT_TYPE}
             ) as resp:
                 if resp.status == 200:
                     self.n_updates += 1
+                    delivered = True
                 elif resp.status == 401:
                     await self.register_with_manager()
                 # 410: reported a stale round; nothing to do (parity with
                 # reference worker.py:123-124)
         except aiohttp.ClientError:
             pass  # manager down; heartbeat loop will re-establish contact
+        if compressed_payload is not None and not delivered:
+            # the kept mass never reached the manager: fold it back into
+            # the error-feedback residual or it is lost for good
+            self.compressor.restore(compressed_payload, compressed_template)
 
     # ------------------------------------------------------------------
     def get_data(self) -> Tuple[dict, int]:

@@ -1,0 +1,380 @@
+"""Update compression (ops/compression.py): top-k + error feedback +
+stochastic quantization.
+
+Oracles: exact top-k selection, EF conservation (transmitted + residual
+== input, to fp precision), unbiasedness of stochastic rounding, and an
+end-to-end compressed-SGD run that converges where plain top-k (no EF)
+stalls.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from baton_tpu.ops.compression import (
+    ErrorFeedbackCompressor,
+    decompress_payload,
+    dequantize,
+    quantize_stochastic,
+    topk_compress,
+    topk_decompress,
+)
+
+
+def _tree(nprng):
+    return {
+        "w": nprng.normal(size=(6, 4)).astype(np.float32),
+        "b": nprng.normal(size=(5,)).astype(np.float32),
+    }
+
+
+def test_topk_keeps_largest_and_roundtrips(nprng):
+    tree = _tree(nprng)
+    payload, residual = topk_compress(tree, 0.25)
+    dense = topk_decompress(payload, tree)
+    for k in tree:
+        flat = np.abs(tree[k].ravel())
+        kept = np.asarray(dense[k]).ravel()
+        n_kept = int((kept != 0).sum())
+        assert n_kept == max(1, round(flat.size * 0.25))
+        # the kept coordinates are exactly the largest-|.| ones
+        thresh = np.sort(flat)[-n_kept]
+        assert np.all(np.abs(kept[kept != 0]) >= thresh - 1e-6)
+        # conservation: kept + residual == input exactly
+        np.testing.assert_allclose(
+            np.asarray(dense[k]) + np.asarray(residual[k]), tree[k],
+            atol=1e-6,
+        )
+
+
+def test_topk_frac_one_is_identity(nprng):
+    tree = _tree(nprng)
+    payload, residual = topk_compress(tree, 1.0)
+    dense = topk_decompress(payload, tree)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(dense[k]), tree[k], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(residual[k]), 0.0, atol=1e-6)
+
+
+def test_topk_rejects_bad_frac(nprng):
+    with pytest.raises(ValueError):
+        topk_compress(_tree(nprng), 0.0)
+
+
+def test_error_feedback_carries_dropped_mass(nprng):
+    """Two rounds of EF: coordinates dropped in round 1 reappear
+    (accumulated) in round 2's pre-compression input."""
+    c = ErrorFeedbackCompressor(frac=0.25)
+    t1 = _tree(nprng)
+    p1 = c.compress(t1)
+    d1 = decompress_payload(p1, t1)
+    # residual holds exactly what was not transmitted
+    for k in t1:
+        np.testing.assert_allclose(
+            np.asarray(d1[k]) + np.asarray(c.residual[k]), t1[k], atol=1e-6
+        )
+    # a zero second update transmits pure residual
+    zero = jax.tree_util.tree_map(np.zeros_like, t1)
+    p2 = c.compress(zero)
+    d2 = decompress_payload(p2, t1)
+    for k in t1:
+        sent = np.asarray(d1[k]) + np.asarray(d2[k])
+        # after two rounds the largest-|.| half of each leaf has been
+        # delivered; total transmitted + final residual still == t1
+        np.testing.assert_allclose(
+            sent + np.asarray(c.residual[k]), t1[k], atol=1e-6
+        )
+
+
+def test_stochastic_quantization_unbiased(nprng):
+    x = {"v": nprng.normal(size=(64,)).astype(np.float32)}
+    draws = []
+    for i in range(400):
+        q = quantize_stochastic(x, jax.random.key(i), bits=8)
+        draws.append(np.asarray(dequantize(q)["v"]))
+    mean = np.mean(draws, axis=0)
+    scale = np.abs(x["v"]).max() / 127.0
+    # SE of the mean of 400 draws of a <=1-step rounding error
+    np.testing.assert_allclose(mean, x["v"], atol=4 * scale / np.sqrt(400))
+
+
+def test_quantized_payload_decodes(nprng):
+    tree = _tree(nprng)
+    c = ErrorFeedbackCompressor(frac=0.5, bits=8)
+    payload = c.compress(tree)
+    dense = decompress_payload(payload, tree)
+    ref, _ = topk_compress(tree, 0.5)
+    ref_dense = topk_decompress(ref, tree)
+    for k in tree:
+        scale = np.abs(np.asarray(ref_dense[k])).max() / 127.0
+        np.testing.assert_allclose(
+            np.asarray(dense[k]), np.asarray(ref_dense[k]), atol=scale + 1e-6
+        )
+
+
+def test_ef_sgd_converges_where_plain_topk_stalls():
+    """Least squares by compressed gradient descent at frac=0.1: with
+    error feedback the iterate reaches the solution; without it the
+    never-selected coordinates are frozen forever."""
+    nprng = np.random.default_rng(0)
+    target = nprng.normal(size=(40,)).astype(np.float32)
+    # scale one coordinate block up so plain top-k always selects it
+    weights = np.ones(40, np.float32)
+    weights[:4] = 100.0
+
+    def grad(x):
+        return {"x": weights * (x["x"] - target)}
+
+    lr = 0.008
+    x_ef = {"x": np.zeros(40, np.float32)}
+    x_pl = {"x": np.zeros(40, np.float32)}
+    ef = ErrorFeedbackCompressor(frac=0.1)
+    for _ in range(500):
+        g = grad(x_ef)
+        step = decompress_payload(ef.compress(
+            jax.tree_util.tree_map(lambda a: lr * a, g)), g)
+        x_ef = {"x": x_ef["x"] - np.asarray(step["x"])}
+
+        g = grad(x_pl)
+        p, _ = topk_compress(
+            jax.tree_util.tree_map(lambda a: lr * a, g), 0.1)
+        x_pl = {"x": x_pl["x"] - np.asarray(topk_decompress(p, g)["x"])}
+
+    err_ef = float(np.linalg.norm(x_ef["x"] - target))
+    err_pl = float(np.linalg.norm(x_pl["x"] - target))
+    assert err_ef < 0.5, err_ef
+    assert err_pl > 2.0, err_pl  # stalled: most coords never updated
+
+
+# ----------------------------------------------------------------------
+# HTTP federation with compressed uploads
+
+
+def test_compressed_federation_over_http():
+    """Workers upload top-k sparse round deltas; the manager reconstructs
+    anchor+delta and the federation still converges to the demo
+    coefficients. With frac=1.0 the reconstruction is exact, so the
+    aggregate must equal the uncompressed weighted mean."""
+    import asyncio
+    import socket
+
+    from aiohttp import web
+
+    from baton_tpu.core.training import make_local_trainer
+    from baton_tpu.data.synthetic import linear_client_data
+    from baton_tpu.models.linear import linear_regression_model
+    from baton_tpu.server.http_manager import Manager
+    from baton_tpu.server.http_worker import ExperimentWorker
+    from baton_tpu.server.state import params_to_state_dict
+
+    def free_port():
+        import socket as s
+
+        with s.socket() as sock:
+            sock.bind(("127.0.0.1", 0))
+            return sock.getsockname()[1]
+
+    async def main():
+        model = linear_regression_model(10)
+        nprng = np.random.default_rng(4)
+        mport = free_port()
+        mapp = web.Application()
+        manager = Manager(mapp)
+        exp = manager.register_experiment(
+            model, name="comptest", round_timeout=60.0
+        )
+        mrunner = web.AppRunner(mapp)
+        await mrunner.setup()
+        await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+        workers, runners, datas = [], [mrunner], []
+        for spec in ("topk:1.0", "topk:0.5:q16"):
+            data = linear_client_data(nprng, min_batches=2, max_batches=2)
+            datas.append(data)
+            wport = free_port()
+            wapp = web.Application()
+            w = ExperimentWorker(
+                wapp, model, f"127.0.0.1:{mport}", name="comptest",
+                port=wport, heartbeat_time=30.0,
+                trainer=make_local_trainer(model, batch_size=32,
+                                           learning_rate=0.02),
+                get_data=lambda d=data: (d, d["x"].shape[0]),
+                compress=spec,
+            )
+            wrunner = web.AppRunner(wapp)
+            await wrunner.setup()
+            await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+            workers.append(w)
+            runners.append(wrunner)
+
+        for _ in range(200):
+            if len(exp.registry) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(exp.registry) == 2
+
+        import aiohttp
+
+        anchors = []
+        async with aiohttp.ClientSession() as session:
+            for _ in range(6):
+                anchors.append({
+                    k: np.asarray(v, np.float64)
+                    for k, v in params_to_state_dict(exp.params).items()
+                })
+                async with session.get(
+                    f"http://127.0.0.1:{mport}/comptest/start_round?n_epoch=4"
+                ) as resp:
+                    assert resp.status == 200
+                for _ in range(200):
+                    if not exp.rounds.in_progress:
+                        break
+                    await asyncio.sleep(0.05)
+                assert not exp.rounds.in_progress
+
+        assert exp.metrics.snapshot()["counters"][
+            "compressed_updates_received"] == 12.0
+
+        # frac=1.0 worker 0: its final upload reconstructs EXACTLY its
+        # trained params (compression lossless at frac 1, no quantizer)
+        got = exp.rounds.client_responses  # last round's uploads
+        w0 = workers[0]
+        sd0 = {k: np.asarray(v, np.float32)
+               for k, v in params_to_state_dict(w0.params).items()}
+        resp0 = got[w0.client_id]["state_dict"]
+        for k in sd0:
+            np.testing.assert_allclose(resp0[k], sd0[k], atol=1e-5)
+
+        # the federation learned the demo coefficients
+        from baton_tpu.data.synthetic import DEMO_COEF
+
+        np.testing.assert_allclose(
+            np.asarray(exp.params["w"]).ravel(), DEMO_COEF, atol=2.0
+        )
+        for r in runners:
+            await r.cleanup()
+
+    asyncio.run(main())
+
+
+def test_secure_round_rejects_compressed_upload():
+    """Sparse uploads leak the changed-coordinate support set; the
+    manager must 400 them in a secure experiment."""
+    import asyncio
+
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from baton_tpu.models.linear import linear_regression_model
+    from baton_tpu.server import wire
+    from baton_tpu.server.http_manager import Manager
+
+    async def main():
+        app = web.Application()
+        manager = Manager(app)
+        exp = manager.register_experiment(
+            linear_regression_model(4), name="sec", secure_agg=True,
+            start_background_tasks=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        resp = await client.get("/sec/register", json={"port": 1})
+        creds = await resp.json()
+        body = wire.encode(
+            {"w@idx": np.zeros(1, np.int32), "w@val": np.zeros(1, np.float32)},
+            {"update_name": "x", "compressed": {"scheme": "topk"}},
+        )
+        resp = await client.post(
+            f"/sec/update?client_id={creds['client_id']}&key={creds['key']}",
+            data=body, headers={"Content-Type": wire.CONTENT_TYPE},
+        )
+        assert resp.status == 400
+        await client.close()
+
+    asyncio.run(main())
+
+
+def test_restore_refolds_undelivered_payload(nprng):
+    """EF invariant under upload failure: compress then restore leaves
+    the residual holding the ENTIRE input, so the mass is delayed, never
+    lost."""
+    c = ErrorFeedbackCompressor(frac=0.25)
+    t = _tree(nprng)
+    payload = c.compress(t)
+    c.restore(payload, t)
+    for k in t:
+        np.testing.assert_allclose(np.asarray(c.residual[k]), t[k], atol=1e-6)
+    # the next compress retransmits what the failed round kept
+    p2 = c.compress(jax.tree_util.tree_map(np.zeros_like, t))
+    d2 = decompress_payload(p2, t)
+    ref, _ = topk_compress(t, 0.25)
+    ref_d = topk_decompress(ref, t)
+    for k in t:
+        np.testing.assert_allclose(np.asarray(d2[k]), np.asarray(ref_d[k]),
+                                   atol=1e-6)
+
+
+def test_parse_compress_rejects_bad_specs():
+    from baton_tpu.server.http_worker import _parse_compress
+
+    for bad in ("topk:0", "topk:0.0", "topk:1.5", "topk:-0.1", "gzip:0.5",
+                "topk:0.5:q7"):
+        with pytest.raises(ValueError):
+            _parse_compress(bad)
+    assert _parse_compress(None) is None
+    c = _parse_compress("topk:0.5:q16")
+    assert c.frac == 0.5 and c.bits == 16
+
+
+def test_manager_rejects_malformed_sparse_uploads():
+    """Door validation (400) for payloads that would crash or poison
+    reconstruction: empty/NaN scale, duplicate indices, NaN values."""
+    import asyncio
+
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from baton_tpu.models.linear import linear_regression_model
+    from baton_tpu.server import wire
+    from baton_tpu.server.http_manager import Manager
+
+    async def main():
+        app = web.Application()
+        manager = Manager(app)
+        manager.register_experiment(
+            linear_regression_model(4), name="v",
+            start_background_tasks=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        resp = await client.get("/v/register", json={"port": 1})
+        creds = await resp.json()
+        auth = f"client_id={creds['client_id']}&key={creds['key']}"
+
+        def sparse(k="w", idx=(0,), val=(1.0,), **extra):
+            t = {f"{k}@idx": np.asarray(idx, np.int32),
+                 f"{k}@val": np.asarray(val, np.float32),
+                 "b@idx": np.zeros(1, np.int32),
+                 "b@val": np.zeros(1, np.float32)}
+            t.update({kk: np.asarray(vv) for kk, vv in extra.items()})
+            return t
+
+        cases = [
+            sparse(idx=(0, 0), val=(1.0, 2.0)),            # duplicate idx
+            sparse(val=(np.nan,)),                          # NaN value
+            sparse(**{"w@scale": np.asarray([], np.float32)}),   # empty scale
+            sparse(**{"w@scale": np.asarray([np.inf], np.float32)}),  # inf
+            sparse(idx=(99,)),                              # out of range
+        ]
+        for tensors in cases:
+            body = wire.encode(
+                tensors, {"update_name": "x",
+                          "compressed": {"scheme": "topk"}},
+            )
+            resp = await client.post(f"/v/update?{auth}", data=body,
+                                     headers={"Content-Type": wire.CONTENT_TYPE})
+            assert resp.status == 400, (resp.status, tensors.keys())
+        await client.close()
+
+    asyncio.run(main())
