@@ -144,17 +144,28 @@ class ErrorFeedbackCompressor:
     frac: float
     bits: Optional[int] = None  # additionally quantize kept values
     residual: Optional[Params] = None
-    _rng: jax.Array = dataclasses.field(
-        default_factory=lambda: jax.random.key(0)
+    # Seed the quantizer DIFFERENTLY per client (http_worker threads its
+    # rng_seed here): identical keys would correlate every client's
+    # rounding errors and the cohort mean's quantization noise would
+    # stop shrinking with cohort size.
+    seed: int = 0
+    _rng: Optional[jax.Array] = None
+    _last_exact: Optional[Params] = dataclasses.field(
+        default=None, repr=False
     )
 
     def compress(self, delta: Params) -> Params:
         payload, self.residual = topk_compress(delta, self.frac,
                                                self.residual)
+        # pre-quantization payload kept for restore(): the EF invariant
+        # must hold exactly per event, not just in expectation
+        self._last_exact = payload
         if self.bits is not None:
             # quantization error is NOT fed back: stochastic rounding is
             # already unbiased per draw, so only top-k's (biased)
             # truncation needs the residual
+            if self._rng is None:
+                self._rng = jax.random.key(self.seed)
             self._rng, sub = jax.random.split(self._rng)
             is_payload = lambda x: isinstance(x, dict) and "idx" in x
             n = len(jax.tree_util.tree_leaves(payload, is_leaf=is_payload))
@@ -170,14 +181,18 @@ class ErrorFeedbackCompressor:
             )
         return payload
 
-    def restore(self, payload: Params, template: Params) -> None:
-        """Fold a compressed-but-never-delivered payload back into the
+    def restore(self, template: Params) -> None:
+        """Fold the last ``compress()``'s kept mass back into the
         residual. Call when the upload FAILS (connection error, stale
         round, auth reset): ``compress`` already moved the kept mass out
-        of the residual as "transmitted", and dropping the payload
-        silently would lose it for good — violating the EF guarantee
-        that dropped mass is only ever delayed."""
-        dense = decompress_payload(payload, template)
+        of the residual as "transmitted", and dropping it silently would
+        lose it for good — violating the EF guarantee that dropped mass
+        is only ever delayed. Restores the EXACT pre-quantization values
+        (the invariant holds per event, not just in expectation)."""
+        if self._last_exact is None:
+            return
+        dense = topk_decompress(self._last_exact, template)
+        self._last_exact = None
         if self.residual is None:
             self.residual = dense
         else:

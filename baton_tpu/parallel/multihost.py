@@ -80,11 +80,37 @@ def make_hybrid_mesh(
         )
     dcn_size = n_proc * (local // ici_total)
     if n_proc > 1:
-        devices = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=[local // ici_total] + ici_sizes,
-            dcn_mesh_shape=[n_proc] + [1] * len(ici_sizes),
-        )
-        devices = devices.reshape((dcn_size,) + tuple(ici_sizes))
+        try:
+            devices = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=[local // ici_total] + ici_sizes,
+                dcn_mesh_shape=[n_proc] + [1] * len(ici_sizes),
+            )
+            devices = devices.reshape((dcn_size,) + tuple(ici_sizes))
+        except ValueError as e:
+            # create_hybrid_device_mesh groups by the devices'
+            # slice_index, which only multi-slice TPU topologies carry;
+            # multi-process CPU (the two-process DCN test,
+            # tests/test_multihost.py) and single-slice-per-host setups
+            # land here. Grouping by process_index preserves the one
+            # property the layout rule needs: each host's devices are
+            # contiguous along the ICI axes, so only the dcn_axis
+            # crosses processes. Any OTHER ValueError (a genuinely
+            # untileable multi-slice layout) must surface, not silently
+            # degrade to a topology-blind ring.
+            if "slice" not in str(e).lower():
+                raise
+            import warnings
+
+            warnings.warn(
+                "create_hybrid_device_mesh found no slice topology "
+                f"({e}); falling back to process-ordered device layout",
+                stacklevel=2,
+            )
+            devs = sorted(jax.devices(),
+                          key=lambda d: (d.process_index, d.id))
+            devices = np.array(devs).reshape(
+                (dcn_size,) + tuple(ici_sizes)
+            )
     else:
         devices = mesh_utils.create_device_mesh(
             (dcn_size,) + tuple(ici_sizes)

@@ -280,6 +280,15 @@ class Experiment:
                         {"err": "Compressed Upload In Secure Round"},
                         status=400,
                     )
+                scheme = (meta["compressed"] or {}).get("scheme") \
+                    if isinstance(meta["compressed"], dict) else None
+                if scheme != "topk":
+                    # an unknown scheme decoded under top-k semantics
+                    # would poison the aggregate; reject precisely
+                    return web.json_response(
+                        {"err": f"Unknown Compression Scheme {scheme!r}"},
+                        status=400,
+                    )
                 # one device-to-host materialization per upload, shared
                 # by validation and reconstruction below
                 compressed_anchor = params_to_state_dict(self.params)

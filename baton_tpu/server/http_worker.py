@@ -50,8 +50,9 @@ GetData = Callable[[], Tuple[dict, int]]
 MAX_BACKOFF = 60.0
 
 
-def _parse_compress(spec: Optional[str]):
-    """``"topk:<frac>[:q8|q16]"`` -> ErrorFeedbackCompressor, else None."""
+def _parse_compress(spec: Optional[str], seed: int = 0):
+    """``"topk:<frac>[:q8|q16]"`` -> ErrorFeedbackCompressor, else None.
+    ``seed`` decorrelates the stochastic quantizer across workers."""
     if spec is None:
         return None
     from baton_tpu.ops.compression import ErrorFeedbackCompressor
@@ -71,7 +72,7 @@ def _parse_compress(spec: Optional[str]):
         if parts[2] not in ("q8", "q16"):
             raise ValueError(f"unknown quantizer {parts[2]!r} in {spec!r}")
         bits = int(parts[2][1:])
-    return ErrorFeedbackCompressor(frac=frac, bits=bits)
+    return ErrorFeedbackCompressor(frac=frac, bits=bits, seed=seed)
 
 
 class ExperimentWorker:
@@ -118,7 +119,7 @@ class ExperimentWorker:
         self.manager = manager
         self.manager_url = f"http://{manager}/{self.name}/"
         self.allow_pickle = allow_pickle
-        self.compressor = _parse_compress(compress)
+        self.compressor = _parse_compress(compress, seed=rng_seed)
         self._round_anchor: Optional[dict] = None
         if get_data is not None:
             self.get_data = get_data  # type: ignore[assignment]
@@ -592,7 +593,7 @@ class ExperimentWorker:
         if compressed_payload is not None and not delivered:
             # the kept mass never reached the manager: fold it back into
             # the error-feedback residual or it is lost for good
-            self.compressor.restore(compressed_payload, compressed_template)
+            self.compressor.restore(compressed_template)
 
     # ------------------------------------------------------------------
     def get_data(self) -> Tuple[dict, int]:

@@ -301,8 +301,8 @@ def test_restore_refolds_undelivered_payload(nprng):
     lost."""
     c = ErrorFeedbackCompressor(frac=0.25)
     t = _tree(nprng)
-    payload = c.compress(t)
-    c.restore(payload, t)
+    c.compress(t)
+    c.restore(t)
     for k in t:
         np.testing.assert_allclose(np.asarray(c.residual[k]), t[k], atol=1e-6)
     # the next compress retransmits what the failed round kept
@@ -375,6 +375,73 @@ def test_manager_rejects_malformed_sparse_uploads():
             resp = await client.post(f"/v/update?{auth}", data=body,
                                      headers={"Content-Type": wire.CONTENT_TYPE})
             assert resp.status == 400, (resp.status, tensors.keys())
+        await client.close()
+
+    asyncio.run(main())
+
+
+def test_restore_is_exact_even_with_quantizer(nprng):
+    """restore() must refold the PRE-quantization values: with q8 the
+    residual after compress+restore still equals the input exactly (the
+    EF guarantee holds per event, not just in expectation)."""
+    c = ErrorFeedbackCompressor(frac=0.25, bits=8)
+    t = _tree(nprng)
+    c.compress(t)
+    c.restore(t)
+    for k in t:
+        np.testing.assert_allclose(np.asarray(c.residual[k]), t[k], atol=1e-6)
+    # restore is idempotent: a second call must not double-fold
+    c.restore(t)
+    for k in t:
+        np.testing.assert_allclose(np.asarray(c.residual[k]), t[k], atol=1e-6)
+
+
+def test_quantizer_seeds_decorrelate_workers(nprng):
+    """Two workers with different seeds must draw different rounding
+    randomness (identical draws would correlate cohort-mean noise)."""
+    t = _tree(nprng)
+    p0 = ErrorFeedbackCompressor(frac=1.0, bits=8, seed=0).compress(t)
+    p1 = ErrorFeedbackCompressor(frac=1.0, bits=8, seed=1).compress(t)
+    same = all(
+        np.array_equal(np.asarray(a["val"]["q"]), np.asarray(b["val"]["q"]))
+        for a, b in zip(p0.values(), p1.values())
+    )
+    assert not same
+
+
+def test_manager_rejects_unknown_compression_scheme():
+    import asyncio
+
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from baton_tpu.models.linear import linear_regression_model
+    from baton_tpu.server import wire
+    from baton_tpu.server.http_manager import Manager
+
+    async def main():
+        app = web.Application()
+        manager = Manager(app)
+        manager.register_experiment(
+            linear_regression_model(4), name="sch",
+            start_background_tasks=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        resp = await client.get("/sch/register", json={"port": 1})
+        creds = await resp.json()
+        for bad in ({"scheme": "qsgd-v2"}, True, {"no_scheme": 1}):
+            body = wire.encode(
+                {"w@idx": np.zeros(1, np.int32),
+                 "w@val": np.zeros(1, np.float32)},
+                {"update_name": "x", "compressed": bad},
+            )
+            resp = await client.post(
+                f"/sch/update?client_id={creds['client_id']}"
+                f"&key={creds['key']}",
+                data=body, headers={"Content-Type": wire.CONTENT_TYPE},
+            )
+            assert resp.status == 400, bad
         await client.close()
 
     asyncio.run(main())
