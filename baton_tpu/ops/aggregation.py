@@ -156,3 +156,24 @@ def coordinate_median(stacked: Params) -> Params:
     return jax.tree_util.tree_map(
         lambda l: jnp.median(l.astype(jnp.float32), axis=0).astype(l.dtype), stacked
     )
+
+
+def parse_aggregator(spec: str):
+    """``"mean" | "trimmed:<ratio>" | "median"`` -> tagged tuple.
+
+    Shared by the simulation engine (FedSim) and the HTTP manager
+    (Experiment): both select between :func:`weighted_tree_mean` and the
+    robust order statistics above from the same spec strings."""
+    if spec == "mean":
+        return ("mean",)
+    if spec == "median":
+        return ("median",)
+    if spec.startswith("trimmed:"):
+        ratio = float(spec.split(":", 1)[1])
+        if not (0.0 <= ratio < 0.5):
+            raise ValueError(f"trim ratio must be in [0, 0.5), got {ratio}")
+        return ("trimmed", ratio)
+    raise ValueError(
+        f"unknown aggregator {spec!r}; expected 'mean', 'median', "
+        "or 'trimmed:<ratio>'"
+    )

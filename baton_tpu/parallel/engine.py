@@ -81,8 +81,23 @@ class FedSim:
         regularizer=None,
         trainable: Optional[PathPredicate] = None,
         dp=None,
+        aggregator: str = "mean",
     ):
+        """``aggregator`` selects the round combine rule:
+
+        * ``"mean"`` (default) — sample-weighted FedAvg, the reference
+          rule (manager.py:119-126); streams as per-wave weighted sums,
+          so memory is O(model), not O(clients x model).
+        * ``"trimmed:<ratio>"`` — coordinate-wise trimmed mean,
+          ``"median"`` — coordinate-wise median (ops/aggregation.py):
+          Byzantine-robust rules that need every client's params
+          materialized ([C, model] HBM — the price of robustness) and
+          are unweighted (standard formulations; a poisoned client
+          could otherwise buy influence by claiming a huge n_samples).
+          Zero-sample clients are excluded before the combine.
+        """
         self.model = model
+        self.aggregator = agg.parse_aggregator(aggregator)
         self.trainer: LocalTrainer = make_local_trainer(
             model,
             optimizer=optimizer,
@@ -202,6 +217,48 @@ class FedSim:
     def _wave_sums_vmap(self, params, frozen, data, n_samples, rngs, n_epochs):
         return self._wave_sums_raw(params, frozen, data, n_samples, rngs, n_epochs)
 
+    # robust-aggregation wave kernel: returns every client's trained
+    # params ([C_wave, ...] stacked) instead of streaming weighted sums —
+    # trimmed mean/median are order statistics and cannot be computed
+    # from sums (engine __init__ docstring on the memory trade)
+    def _wave_params_raw(self, params, frozen, data, n_samples, rngs, n_epochs):
+        anchor = params if self.trainer.regularizer is not None else None
+
+        def one_client(d, n, r):
+            p, _, losses = self.trainer.train(
+                params, d, n, r, n_epochs, anchor, frozen
+            )
+            return p, losses
+
+        return jax.vmap(one_client)(data, n_samples, rngs)
+
+    @partial(jax.jit, static_argnums=(0, 6))
+    def _wave_params_vmap(self, params, frozen, data, n_samples, rngs, n_epochs):
+        return self._wave_params_raw(params, frozen, data, n_samples, rngs,
+                                     n_epochs)
+
+    def _make_wave_params_sharded(self, n_epochs: int):
+        cache = getattr(self, "_sharded_params_cache", None)
+        if cache is None:
+            cache = self._sharded_params_cache = {}
+        if n_epochs not in cache:
+            mesh = self.mesh
+
+            def kernel(params, frozen, data, n_samples, rngs):
+                return self._wave_params_raw(
+                    params, frozen, data, n_samples, rngs, n_epochs
+                )
+
+            cache[n_epochs] = jax.jit(jax.shard_map(
+                kernel,
+                mesh=mesh,
+                in_specs=(P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
+                          P(CLIENT_AXIS)),
+                out_specs=(P(CLIENT_AXIS), P(CLIENT_AXIS)),
+                check_vma=False,
+            ))
+        return cache[n_epochs]
+
     def _make_wave_sums_sharded(self, n_epochs: int, raw: bool = False):
         # Cache per n_epochs: rebuilding the shard_map closure every round
         # would hand jit a fresh function and force an XLA recompile.
@@ -306,6 +363,14 @@ class FedSim:
         else:
             wave_size = round_up(wave_size, n_dev)
 
+        robust = self.aggregator[0] != "mean"
+        if robust and self.is_hybrid:
+            raise NotImplementedError(
+                "robust aggregators need per-client params stacked along "
+                "the client axis; the hybrid clients x model mesh shards "
+                "params over 'model' — run robust rounds on a pure "
+                "clients mesh"
+            )
         if self.is_hybrid:
             # hybrid clients×model mesh: plain jit + GSPMD (see
             # _place_hybrid) — shard_map would force manual TP collectives
@@ -315,18 +380,28 @@ class FedSim:
             )
             in_shard = client_sharding(self.mesh)
         elif self.mesh is not None:
-            wave_fn = self._make_wave_sums_sharded(n_epochs)
-            call = lambda d, n, r: wave_fn(params, frozen, d, n, r)
+            if robust:
+                wave_p = self._make_wave_params_sharded(n_epochs)
+                call_p = lambda d, n, r: wave_p(params, frozen, d, n, r)
+            else:
+                wave_fn = self._make_wave_sums_sharded(n_epochs)
+                call = lambda d, n, r: wave_fn(params, frozen, d, n, r)
             in_shard = client_sharding(self.mesh)
         else:
-            call = lambda d, n, r: self._wave_sums_vmap(
-                params, frozen, d, n, r, n_epochs
-            )
+            if robust:
+                call_p = lambda d, n, r: self._wave_params_vmap(
+                    params, frozen, d, n, r, n_epochs
+                )
+            else:
+                call = lambda d, n, r: self._wave_sums_vmap(
+                    params, frozen, d, n, r, n_epochs
+                )
             in_shard = None
 
         psum_acc = None
         lsum_acc = None
         w_acc = None
+        stacked_parts = [] if robust else None
         per_client = [] if collect_client_losses else None
         for start in range(0, c, wave_size):
             stop = min(start + wave_size, c)
@@ -340,8 +415,22 @@ class FedSim:
                 )
                 n = jax.device_put(n, in_shard)
                 r = jax.device_put(r, in_shard)
-            psum, lsum, wtot, closs = call(d, n, r)
-            psum_acc = psum if psum_acc is None else agg.tree_add(psum_acc, psum)
+            if robust:
+                cp, closs = call_p(d, n, r)
+                real = stop - start
+                stacked_parts.append(
+                    jax.tree_util.tree_map(lambda a: a[:real], cp)
+                )
+                w_wave = n[:real].astype(jnp.float32)
+                lsum = jnp.tensordot(w_wave,
+                                     closs[:real].astype(jnp.float32),
+                                     axes=(0, 0))
+                wtot = jnp.sum(w_wave)
+            else:
+                psum, lsum, wtot, closs = call(d, n, r)
+                psum_acc = (
+                    psum if psum_acc is None else agg.tree_add(psum_acc, psum)
+                )
             lsum_acc = lsum if lsum_acc is None else lsum_acc + lsum
             w_acc = wtot if w_acc is None else w_acc + wtot
             if per_client is not None:
@@ -351,9 +440,32 @@ class FedSim:
                 progress_fn(start // wave_size + 1, -(-c // wave_size))
 
         denom = jnp.maximum(w_acc, 1e-9)
-        aggregate = jax.tree_util.tree_map(
-            lambda s, ref: (s / denom).astype(ref.dtype), psum_acc, params
-        )
+        if robust:
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *stacked_parts
+            )
+            # order statistics over REAL participants only: zero-sample
+            # clients never trained (their update is the unchanged
+            # broadcast) and would bias the trim/median toward no-op
+            keep = np.flatnonzero(np.asarray(n_samples) > 0)
+            if keep.size == 0:
+                # nobody trained: the round is a no-op, like the
+                # reference's zero-accepting-clients auto-end
+                keep = np.arange(int(n_samples.shape[0]))
+            stacked = jax.tree_util.tree_map(
+                lambda a: jnp.take(a, jnp.asarray(keep), axis=0), stacked
+            )
+            if self.aggregator[0] == "trimmed":
+                merged = agg.trimmed_mean(stacked, self.aggregator[1])
+            else:
+                merged = agg.coordinate_median(stacked)
+            aggregate = jax.tree_util.tree_map(
+                lambda m, ref: m.astype(ref.dtype), merged, params
+            )
+        else:
+            aggregate = jax.tree_util.tree_map(
+                lambda s, ref: (s / denom).astype(ref.dtype), psum_acc, params
+            )
         loss_history = lsum_acc / denom
 
         if self.server_optimizer is not None:
@@ -605,6 +717,11 @@ class FedSim:
     ):
         """``run_rounds`` as a single XLA dispatch.
 
+        Robust aggregators are not supported here (the fused kernel
+        streams weighted sums; order statistics would need every
+        client's params live inside the scan) — use :meth:`run_round` /
+        :meth:`run_rounds`, which apply them per round.
+
         ``donate_buffers=True`` donates the params/server-opt input
         buffers to XLA (the returned arrays alias them) — use on the
         production path when the caller no longer needs the old globals;
@@ -620,6 +737,12 @@ class FedSim:
         (same fold_in round rngs; bitwise-equal when the cohort needs no
         phantom padding).
         """
+        if self.aggregator[0] != "mean":
+            raise NotImplementedError(
+                "run_rounds_fused streams weighted sums and cannot apply "
+                f"the {self.aggregator[0]!r} aggregator; use run_round/"
+                "run_rounds for robust aggregation"
+            )
         params, frozen = self._split(params)
         n_samples = jnp.asarray(n_samples)
         c = int(n_samples.shape[0])

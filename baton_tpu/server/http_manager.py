@@ -101,11 +101,24 @@ class Experiment:
         metrics: Optional[Metrics] = None,
         secure_agg: bool = False,
         secure_scale_bits: int = 16,
+        aggregator: str = "mean",
     ):
+        """``aggregator``: ``"mean"`` (sample-weighted FedAvg, reference
+        manager.py:119-126), or Byzantine-robust ``"trimmed:<ratio>"`` /
+        ``"median"`` (coordinate-wise order statistics over the round's
+        reporters, unweighted — a poisoned client must not buy influence
+        via a claimed n_samples; ops/aggregation.py)."""
         if secure_agg and allow_pickle:
             raise ValueError(
                 "secure_agg is incompatible with allow_pickle: reference-"
                 "protocol pickle workers cannot speak the masking protocol"
+            )
+        self.aggregator = agg.parse_aggregator(aggregator)
+        if secure_agg and self.aggregator[0] != "mean":
+            raise ValueError(
+                "robust aggregators are incompatible with secure_agg: the "
+                "server only ever sees the cohort SUM, never per-client "
+                "updates to trim or take medians over"
             )
         self.name = name
         self.app = app
@@ -752,7 +765,12 @@ class Experiment:
             k: jnp.stack([np.asarray(r["state_dict"][k]) for r in reports])
             for k in template
         }
-        merged = agg.weighted_tree_mean(stacked, weights)
+        if self.aggregator[0] == "trimmed":
+            merged = agg.trimmed_mean(stacked, self.aggregator[1])
+        elif self.aggregator[0] == "median":
+            merged = agg.coordinate_median(stacked)
+        else:
+            merged = agg.weighted_tree_mean(stacked, weights)
         self.params = state_dict_to_params(self.params, {k: np.asarray(v) for k, v in merged.items()})
         self._record_history_and_checkpoint(reports, n_epoch)
 

@@ -181,3 +181,83 @@ def test_run_round_progress_fn_reports_each_wave(linear_setup):
                         progress_fn=lambda d, t: calls.append((d, t)))
     assert calls == [(1, 3), (2, 3), (3, 3)], calls
     assert np.isfinite(float(res.loss_history[-1]))
+
+
+def test_robust_aggregators_match_manual_oracle(linear_setup):
+    """aggregator="trimmed:r"/"median" == manually training each client
+    and applying ops/aggregation's order statistic (unweighted, real
+    participants only)."""
+    model, params, data, n_samples = linear_setup
+    c = int(n_samples.shape[0])
+    rngs = jax.random.split(jax.random.key(7), c)
+    sim0 = FedSim(model, batch_size=32, learning_rate=0.01)
+    client_params = []
+    for i in range(c):
+        d = {k: v[i] for k, v in data.items()}
+        p, _, _ = sim0.trainer.train(params, d, n_samples[i], rngs[i], 1)
+        client_params.append(p)
+    stacked = {
+        "w": jnp.stack([p["w"] for p in client_params]),
+        "b": jnp.stack([p["b"] for p in client_params]),
+    }
+    from baton_tpu.ops import aggregation as agg
+
+    for spec, oracle in (
+        ("trimmed:0.2", lambda s: agg.trimmed_mean(s, 0.2)),
+        ("median", agg.coordinate_median),
+    ):
+        sim = FedSim(model, batch_size=32, learning_rate=0.01,
+                     aggregator=spec)
+        res = sim.run_round(params, data, n_samples, jax.random.key(7),
+                            n_epochs=1, wave_size=3)
+        want = oracle(stacked)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(res.params[k]), np.asarray(want[k]), rtol=1e-5,
+                atol=1e-6,
+            )
+
+
+def test_robust_aggregator_survives_poisoned_client(linear_setup):
+    """One client's data scaled by 1e4 wrecks the weighted mean but not
+    the coordinate median."""
+    model, params, data, n_samples = linear_setup
+    data = dict(data)
+    data["y"] = data["y"].at[0].mul(1e4)  # client 0 trains on garbage
+
+    res_mean = FedSim(model, batch_size=32, learning_rate=0.01).run_round(
+        params, data, n_samples, jax.random.key(3), n_epochs=1)
+    res_med = FedSim(model, batch_size=32, learning_rate=0.01,
+                     aggregator="median").run_round(
+        params, data, n_samples, jax.random.key(3), n_epochs=1)
+
+    from baton_tpu.data.synthetic import DEMO_COEF
+
+    err_mean = float(np.max(np.abs(np.asarray(res_mean.params["w"]).ravel()
+                                   - DEMO_COEF)))
+    err_med = float(np.max(np.abs(np.asarray(res_med.params["w"]).ravel()
+                                  - DEMO_COEF)))
+    assert err_med < 15.0 < err_mean, (err_med, err_mean)
+
+
+def test_bad_aggregator_spec_rejected(linear_setup):
+    import pytest
+
+    model, *_ = linear_setup
+    for bad in ("trimmed:0.5", "trimmed:-0.1", "krum", ""):
+        with pytest.raises(ValueError):
+            FedSim(model, aggregator=bad)
+
+
+def test_robust_aggregator_on_mesh_matches_single_device(linear_setup):
+    model, params, data, n_samples = linear_setup
+    kw = dict(batch_size=32, learning_rate=0.01, aggregator="trimmed:0.2")
+    r_one = FedSim(model, **kw).run_round(
+        params, data, n_samples, jax.random.key(5), n_epochs=1)
+    r_mesh = FedSim(model, mesh=make_mesh(8), **kw).run_round(
+        params, data, n_samples, jax.random.key(5), n_epochs=1)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(r_mesh.params[k]), np.asarray(r_one.params[k]),
+            rtol=1e-5, atol=1e-6,
+        )

@@ -456,3 +456,80 @@ def test_simulated_cohort_round_with_wave_progress():
         await client.close()
 
     run(main())
+
+
+def test_byzantine_worker_defeated_by_median_aggregator():
+    """End-to-end robustness: 3 honest workers + 1 that uploads garbage
+    (1e6-scaled weights). With aggregator="median" the global model still
+    converges toward the demo coefficients; the poisoned upload is
+    outvoted coordinate-wise."""
+
+    async def main():
+        model = linear_regression_model(10)
+        nprng = np.random.default_rng(11)
+        mport = free_port()
+        mapp = web.Application()
+        manager = Manager(mapp)
+        exp = manager.register_experiment(
+            model, name="byz", round_timeout=60.0, aggregator="median"
+        )
+        mrunner = web.AppRunner(mapp)
+        await mrunner.setup()
+        await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+        class ByzantineWorker(ExperimentWorker):
+            async def report_update(self, round_name, n_samples, loss_history):
+                # poison: scale trained weights by 1e6, claim huge weight
+                self.params = jax.tree_util.tree_map(
+                    lambda a: a * 1e6, self.params
+                )
+                await super().report_update(round_name, 10_000, loss_history)
+
+        runners, workers = [mrunner], []
+        shared = make_local_trainer(model, batch_size=32, learning_rate=0.02)
+        for i in range(4):
+            data = linear_client_data(nprng, min_batches=2, max_batches=2)
+            wport = free_port()
+            wapp = web.Application()
+            cls = ByzantineWorker if i == 3 else ExperimentWorker
+            w = cls(wapp, model, f"127.0.0.1:{mport}", name="byz",
+                    port=wport, heartbeat_time=30.0, trainer=shared,
+                    get_data=lambda d=data: (d, d["x"].shape[0]))
+            wrunner = web.AppRunner(wapp)
+            await wrunner.setup()
+            await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+            workers.append(w)
+            runners.append(wrunner)
+
+        for _ in range(200):
+            if len(exp.registry) == 4:
+                break
+            await asyncio.sleep(0.05)
+        assert len(exp.registry) == 4
+
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            for _ in range(6):
+                async with session.get(
+                    f"http://127.0.0.1:{mport}/byz/start_round?n_epoch=4"
+                ) as resp:
+                    assert resp.status == 200
+                for _ in range(200):
+                    if not exp.rounds.in_progress:
+                        break
+                    await asyncio.sleep(0.05)
+                assert not exp.rounds.in_progress
+
+        from baton_tpu.data.synthetic import DEMO_COEF
+
+        w_final = np.asarray(exp.params["w"]).ravel()
+        err = float(np.max(np.abs(w_final - DEMO_COEF)))
+        # the median survives a 1e6-scaled poisoner; the mean would be
+        # astronomically far away
+        assert err < 5.0, err
+
+        for r in runners:
+            await r.cleanup()
+
+    run(main())
