@@ -177,3 +177,14 @@ def parse_aggregator(spec: str):
         f"unknown aggregator {spec!r}; expected 'mean', 'median', "
         "or 'trimmed:<ratio>'"
     )
+
+
+def apply_aggregator(spec, stacked: Params, weights: jax.Array) -> Params:
+    """Dispatch a :func:`parse_aggregator` tuple over stacked client
+    params — the single combine switch shared by the engine and the HTTP
+    manager (robust rules ignore ``weights`` by design)."""
+    if spec[0] == "trimmed":
+        return trimmed_mean(stacked, spec[1])
+    if spec[0] == "median":
+        return coordinate_median(stacked)
+    return weighted_tree_mean(stacked, weights)

@@ -1,5 +1,6 @@
 from baton_tpu.parallel.mesh import make_mesh, client_sharding, replicated_sharding
 from baton_tpu.parallel.engine import FedSim, RoundResult
+from baton_tpu.parallel.fedbuff import AsyncResult, FedBuff
 from baton_tpu.parallel.ring_attention import (
     ring_attention,
     ulysses_attention,
@@ -19,6 +20,8 @@ __all__ = [
     "replicated_sharding",
     "FedSim",
     "RoundResult",
+    "FedBuff",
+    "AsyncResult",
     "ring_attention",
     "ulysses_attention",
     "make_ring_attention_fn",

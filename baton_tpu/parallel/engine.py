@@ -455,10 +455,7 @@ class FedSim:
             stacked = jax.tree_util.tree_map(
                 lambda a: jnp.take(a, jnp.asarray(keep), axis=0), stacked
             )
-            if self.aggregator[0] == "trimmed":
-                merged = agg.trimmed_mean(stacked, self.aggregator[1])
-            else:
-                merged = agg.coordinate_median(stacked)
+            merged = agg.apply_aggregator(self.aggregator, stacked, None)
             aggregate = jax.tree_util.tree_map(
                 lambda m, ref: m.astype(ref.dtype), merged, params
             )

@@ -765,12 +765,7 @@ class Experiment:
             k: jnp.stack([np.asarray(r["state_dict"][k]) for r in reports])
             for k in template
         }
-        if self.aggregator[0] == "trimmed":
-            merged = agg.trimmed_mean(stacked, self.aggregator[1])
-        elif self.aggregator[0] == "median":
-            merged = agg.coordinate_median(stacked)
-        else:
-            merged = agg.weighted_tree_mean(stacked, weights)
+        merged = agg.apply_aggregator(self.aggregator, stacked, weights)
         self.params = state_dict_to_params(self.params, {k: np.asarray(v) for k, v in merged.items()})
         self._record_history_and_checkpoint(reports, n_epoch)
 

@@ -1,0 +1,195 @@
+"""Asynchronous buffered aggregation (parallel/fedbuff.py).
+
+Oracles: a zero-staleness FedBuff step equals the closed-form weighted
+delta mean; staleness accounting matches the queue structure; async
+training with overlap still recovers the demo coefficients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from baton_tpu.data.synthetic import DEMO_COEF, linear_client_data
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.ops.padding import stack_client_datasets
+from baton_tpu.parallel.engine import FedSim
+from baton_tpu.parallel.fedbuff import FedBuff
+
+
+@pytest.fixture
+def setup(nprng):
+    model = linear_regression_model(10)
+    datasets = [
+        linear_client_data(nprng, min_batches=2, max_batches=3)
+        for _ in range(6)
+    ]
+    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    sim = FedSim(model, batch_size=32, learning_rate=0.02)
+    params = sim.init(jax.random.key(0))
+    return sim, params, data, jnp.asarray(n_samples)
+
+
+def test_zero_staleness_step_equals_weighted_delta_mean(setup):
+    """concurrency == buffer_size == C: every client anchors at the
+    current globals, so one async step == one synchronous FedAvg round
+    (delta form) with the same rng chain."""
+    sim, params, data, n_samples = setup
+    c = int(n_samples.shape[0])
+    fb = FedBuff(sim, buffer_size=c, concurrency=c, alpha=0.5)
+    key = jax.random.key(42)
+    res = fb.run(params, data, n_samples, key, n_steps=1, n_epochs=2)
+    assert res.mean_staleness == 0.0 and res.version == 1
+
+    # oracle: replicate the rng chain, train each client from params,
+    # apply the sample-weighted mean of deltas
+    _, sub = jax.random.split(key)
+    r_k = jax.random.split(sub, c)
+    num = None
+    den = 0.0
+    for i in range(c):
+        d = {k: v[i] for k, v in data.items()}
+        p, _, _ = sim.trainer.train(params, d, n_samples[i], r_k[i], 2)
+        w = float(n_samples[i])
+        delta = jax.tree_util.tree_map(
+            lambda a, b: w * (np.asarray(a, np.float64) - np.asarray(b, np.float64)),
+            p, params,
+        )
+        num = delta if num is None else jax.tree_util.tree_map(
+            lambda x, y: x + y, num, delta)
+        den += w
+    for k in ("w", "b"):
+        want = np.asarray(params[k], np.float64) + np.asarray(num[k]) / den
+        np.testing.assert_allclose(np.asarray(res.params[k]), want,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_staleness_emerges_from_overlap(setup):
+    """concurrency > buffer_size: later completions carry the age of
+    their anchor. With concurrency=4, buffer=2, the first step's batch is
+    fresh (staleness 0), the second completes clients anchored before
+    step 1 (staleness 1), so the mean over both steps is 0.5."""
+    sim, params, data, n_samples = setup
+    fb = FedBuff(sim, buffer_size=2, concurrency=4, alpha=0.5)
+    res = fb.run(params, data, n_samples, jax.random.key(1), n_steps=2)
+    assert res.version == 2
+    np.testing.assert_allclose(res.mean_staleness, 0.5)
+
+
+def test_async_training_converges_with_staleness(setup):
+    sim, params, data, n_samples = setup
+    fb = FedBuff(sim, buffer_size=2, concurrency=6, alpha=0.5)
+    res = fb.run(params, data, n_samples, jax.random.key(2), n_steps=40,
+                 n_epochs=2)
+    assert res.mean_staleness > 0.5  # genuine overlap happened
+    err = float(np.max(np.abs(np.asarray(res.params["w"]).ravel() - DEMO_COEF)))
+    assert err < 1.0, err
+    assert res.loss_history[-1] < res.loss_history[0] * 0.1
+
+
+def test_config_validation(setup):
+    sim, *_ = setup
+    with pytest.raises(ValueError):
+        FedBuff(sim, buffer_size=4, concurrency=2)
+    with pytest.raises(ValueError):
+        FedBuff(sim, buffer_size=0, concurrency=2)
+    robust = FedSim(sim.model, batch_size=32, aggregator="median")
+    with pytest.raises(ValueError):
+        FedBuff(robust)
+
+
+def test_default_server_lr_tames_overlap_amplification(nprng):
+    """Overlap re-applies same-anchor movement ~concurrency/buffer times;
+    server_lr defaults to the reciprocal. This is the exact config where
+    full-strength application (server_lr=1.0) was observed to DIVERGE
+    (loss -> 1e6s) while the default converges to the solution: 8
+    clients, concurrency 8, buffer 2, client lr 0.02, 2 local epochs."""
+    model = linear_regression_model(10)
+    datasets = [linear_client_data(nprng) for _ in range(8)]
+    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+    sim = FedSim(model, batch_size=32, learning_rate=0.02)
+    params = sim.init(jax.random.key(0))
+
+    kw = dict(buffer_size=2, concurrency=8, alpha=0.5)
+    res_def = FedBuff(sim, **kw).run(
+        params, data, n_samples, jax.random.key(1), n_steps=60, n_epochs=2)
+    res_full = FedBuff(sim, server_lr=1.0, **kw).run(
+        params, data, n_samples, jax.random.key(1), n_steps=60, n_epochs=2)
+    err_def = float(np.max(np.abs(
+        np.asarray(res_def.params["w"]).ravel() - DEMO_COEF)))
+    err_full = float(np.max(np.abs(
+        np.asarray(res_full.params["w"]).ravel() - DEMO_COEF)))
+    assert err_def < 0.5, err_def
+    assert err_full > 100.0, err_full  # diverged without the damping
+
+
+def test_fedbuff_with_fedprox_regularizer(setup):
+    """A FedProx-configured sim must run async: each client's proximal
+    anchor is its own stale start point (review fix — this crashed with
+    anchor=None before)."""
+    from baton_tpu.core.regularizers import fedprox
+
+    sim, params, data, n_samples = setup
+    sim_prox = FedSim(sim.model, batch_size=32, learning_rate=0.02,
+                      regularizer=fedprox(mu=0.1))
+    fb = FedBuff(sim_prox, buffer_size=2, concurrency=4)
+    res = fb.run(params, data, n_samples, jax.random.key(5), n_steps=20,
+                 n_epochs=2)
+    err = float(np.max(np.abs(np.asarray(res.params["w"]).ravel()
+                              - DEMO_COEF)))
+    assert err < 2.0, err
+
+
+def test_fedbuff_honors_lora_partition(nprng):
+    """With a trainable predicate, async training must leave frozen
+    leaves bit-identical and only move the trainable ones."""
+    from baton_tpu.models.mlp import mlp_classifier_model
+
+    model = mlp_classifier_model(8, (16,), 4)
+    datasets = []
+    for _ in range(4):
+        datasets.append({
+            "x": nprng.normal(size=(32, 8)).astype(np.float32),
+            "y": nprng.integers(0, 4, size=(32,)).astype(np.int32),
+        })
+    data, n_samples = stack_client_datasets(datasets, batch_size=16)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+
+    # freeze everything except the final layer (paths are "0/w", "1/w"…)
+    def head_only(path, leaf):
+        return path.startswith("1/")
+
+    sim = FedSim(model, batch_size=16, learning_rate=0.05,
+                 trainable=head_only)
+    params = sim.init(jax.random.key(0))
+    fb = FedBuff(sim, buffer_size=2, concurrency=4)
+    res = fb.run(params, data, jnp.asarray(n_samples), jax.random.key(6),
+                 n_steps=6)
+
+    flat0 = dict(jax.tree_util.tree_leaves_with_path(params))
+    flat1 = dict(jax.tree_util.tree_leaves_with_path(res.params))
+    moved = frozen_same = 0
+    for kp, leaf in flat0.items():
+        path = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in kp)
+        if head_only(path, leaf):
+            if not np.allclose(np.asarray(leaf), np.asarray(flat1[kp])):
+                moved += 1
+        else:
+            np.testing.assert_array_equal(np.asarray(leaf),
+                                          np.asarray(flat1[kp]))
+            frozen_same += 1
+    assert moved >= 1 and frozen_same >= 1
+
+
+def test_fedbuff_rejects_server_optimizer(setup):
+    import optax
+
+    sim, *_ = setup
+    opt_sim = FedSim(sim.model, batch_size=32,
+                     server_optimizer=optax.adam(1e-2))
+    with pytest.raises(ValueError):
+        FedBuff(opt_sim)
