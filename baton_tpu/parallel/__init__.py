@@ -1,6 +1,7 @@
 from baton_tpu.parallel.mesh import make_mesh, client_sharding, replicated_sharding
 from baton_tpu.parallel.engine import FedSim, RoundResult
 from baton_tpu.parallel.fedbuff import AsyncResult, FedBuff
+from baton_tpu.parallel.personalization import FedPer, PersonalizedRoundResult
 from baton_tpu.parallel.ring_attention import (
     ring_attention,
     ulysses_attention,
@@ -22,6 +23,8 @@ __all__ = [
     "RoundResult",
     "FedBuff",
     "AsyncResult",
+    "FedPer",
+    "PersonalizedRoundResult",
     "ring_attention",
     "ulysses_attention",
     "make_ring_attention_fn",

@@ -92,6 +92,12 @@ class FedBuff:
                 "a FedOpt server optimizer would be silently ignored — "
                 "configure the FedSim without one for async runs"
             )
+        if sim.mesh is not None:
+            raise ValueError(
+                "FedBuff dispatches a single-device vmap per buffer; a "
+                "mesh-configured FedSim would silently run unsharded — "
+                "use a meshless FedSim for async runs"
+            )
         self.sim = sim
         self.buffer_size = buffer_size
         self.concurrency = concurrency
