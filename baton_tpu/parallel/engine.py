@@ -61,6 +61,33 @@ class RoundResult:
     server_opt_state: Any = None
 
 
+def client_eval_sums(model: FedModel, params, d, n, r):
+    """One client's evaluation sums: masked loss sum, valid count, and —
+    for rank-1 integer labels — correct-prediction sum. The single
+    definition of the accuracy-eligibility rule, shared by FedSim's
+    federated eval and FedPer's personalized eval
+    (parallel/personalization.py)."""
+    losses = model.per_example_loss(params, d, r)
+    mask = (jnp.arange(losses.shape[0]) < n).astype(jnp.float32)
+    out = {
+        "loss_sum": jnp.sum(losses.astype(jnp.float32) * mask),
+        "n": mask.sum(),
+    }
+    y = d.get("y")
+    # accuracy only for rank-1 class labels (y [B] matching the
+    # per-example losses); sequence targets (LM: y [B, L]) have no
+    # single-label accuracy and would shape-mismatch the mask
+    if (y is not None and jnp.issubdtype(y.dtype, jnp.integer)
+            and y.ndim == losses.ndim):
+        # model.apply here repeats per_example_loss's forward
+        # structurally — XLA CSEs the shared subgraph (measured:
+        # +2.6% flops vs loss-only, not 2x), so one jit is enough
+        logits = model.apply(params, d, r)
+        correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
+        out["correct_sum"] = jnp.sum(correct * mask)
+    return out
+
+
 class FedSim:
     """Simulated-clients federated training on one device or a mesh.
 
@@ -493,25 +520,7 @@ class FedSim:
     @partial(jax.jit, static_argnums=(0,))
     def _eval_sums_vmap(self, params, data, n_samples, rngs):
         def one(d, n, r):
-            losses = self.model.per_example_loss(params, d, r)
-            mask = (jnp.arange(losses.shape[0]) < n).astype(jnp.float32)
-            out = {
-                "loss_sum": jnp.sum(losses.astype(jnp.float32) * mask),
-                "n": mask.sum(),
-            }
-            y = d.get("y")
-            # accuracy only for rank-1 class labels (y [B] matching the
-            # per-example losses); sequence targets (LM: y [B, L]) have
-            # no single-label accuracy and would shape-mismatch the mask
-            if (y is not None and jnp.issubdtype(y.dtype, jnp.integer)
-                    and y.ndim == losses.ndim):
-                # model.apply here repeats per_example_loss's forward
-                # structurally — XLA CSEs the shared subgraph (measured:
-                # +2.6% flops vs loss-only, not 2x), so one jit is enough
-                logits = self.model.apply(params, d, r)
-                correct = (jnp.argmax(logits, axis=-1) == y).astype(jnp.float32)
-                out["correct_sum"] = jnp.sum(correct * mask)
-            return out
+            return client_eval_sums(self.model, params, d, n, r)
 
         sums = jax.vmap(one)(data, n_samples, rngs)
         return jax.tree_util.tree_map(jnp.sum, sums)

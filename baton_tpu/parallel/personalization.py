@@ -32,7 +32,7 @@ import numpy as np
 
 from baton_tpu.core.partition import PathPredicate, make_partition
 from baton_tpu.ops import aggregation as agg
-from baton_tpu.parallel.engine import FedSim
+from baton_tpu.parallel.engine import FedSim, client_eval_sums
 
 Params = Any
 
@@ -137,7 +137,24 @@ class FedPer:
         )
 
         w = n_samples.astype(jnp.float32)
-        shared_agg = agg.apply_aggregator(self.sim.aggregator, new_shared, w)
+        if self.sim.aggregator[0] != "mean":
+            # order statistics over REAL participants only (mirrors the
+            # engine's robust branch): a zero-sample client's shared
+            # leaves are the unchanged broadcast, and enough of them
+            # would pull the trim/median toward a no-op round
+            keep = np.flatnonzero(np.asarray(n_samples) > 0)
+            if keep.size == 0:
+                keep = np.arange(c)
+            kept_shared = jax.tree_util.tree_map(
+                lambda a: jnp.take(a, jnp.asarray(keep), axis=0), new_shared
+            )
+            shared_agg = agg.apply_aggregator(
+                self.sim.aggregator, kept_shared, None
+            )
+        else:
+            shared_agg = agg.apply_aggregator(
+                self.sim.aggregator, new_shared, w
+            )
         # warm start for future clients: unweighted mean of personal leaves
         pers_mean = jax.tree_util.tree_map(
             lambda l: jnp.mean(l.astype(jnp.float32), axis=0).astype(l.dtype),
@@ -145,10 +162,7 @@ class FedPer:
         )
         new_params = self.partition.merge(pers_mean, shared_agg)
 
-        denom = jnp.maximum(jnp.sum(w), 1e-9)
-        loss_history = (
-            jnp.tensordot(w, closs.astype(jnp.float32), axes=(0, 0)) / denom
-        )
+        loss_history = agg.weighted_scalar_mean(closs, w)
         return PersonalizedRoundResult(
             params=new_params,
             personal_state=new_pers,
@@ -193,22 +207,10 @@ class FedPer:
         @jax.jit
         def eval_all(personal_state, shared, data, n_samples, rngs):
             def one(pers, d, n, r):
-                full = part.merge(pers, shared)
-                losses = model.per_example_loss(full, d, r)
-                mask = (jnp.arange(losses.shape[0]) < n).astype(jnp.float32)
-                out = {
-                    "loss_sum": jnp.sum(losses.astype(jnp.float32) * mask),
-                    "n": mask.sum(),
-                }
-                y = d.get("y")
-                if (y is not None and jnp.issubdtype(y.dtype, jnp.integer)
-                        and y.ndim == losses.ndim):
-                    logits = model.apply(full, d, r)
-                    correct = (
-                        jnp.argmax(logits, axis=-1) == y
-                    ).astype(jnp.float32)
-                    out["correct_sum"] = jnp.sum(correct * mask)
-                return out
+                # same sums kernel as FedSim's federated eval — one
+                # definition of the accuracy-eligibility rule
+                return client_eval_sums(model, part.merge(pers, shared),
+                                        d, n, r)
 
             sums = jax.vmap(one)(personal_state, data, n_samples, rngs)
             return jax.tree_util.tree_map(jnp.sum, sums)

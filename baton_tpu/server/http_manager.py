@@ -31,11 +31,16 @@ reconstructs dropped members' mask keys and reporters' self-mask seeds
 from ≥t shares (``POST /{worker}/secure_unmask``) before dequantizing
 the sum.
 
-Aggregation is the engine's weighted tree mean — numerically the
-reference formula ``Σ(w·θ)/Σw`` (manager.py:119-126) — and an attached
+Aggregation defaults to the engine's weighted tree mean — numerically
+the reference formula ``Σ(w·θ)/Σw`` (manager.py:119-126) — with
+Byzantine-robust alternatives via ``aggregator="trimmed:<r>"|"median"``
+(ops/aggregation.py), and an attached
 :class:`baton_tpu.parallel.engine.FedSim` can contribute a whole TPU-
 simulated cohort to the same round as one weighted participant, so real
 edge clients and on-mesh simulated clients compose in one federation.
+Workers may upload top-k sparse round deltas (``compress=`` on the
+worker; ops/compression.py) — reconstructed here against the round's
+broadcast anchor.
 """
 
 from __future__ import annotations

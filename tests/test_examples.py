@@ -109,3 +109,11 @@ def test_lstm_shakespeare():
     # learns below next-char chance (log V) on Markov text
     assert history[-1] < history[0]
     assert np.isfinite(metrics["loss"])
+
+
+def test_advanced_aggregation():
+    m = _load("08_advanced_aggregation")
+    out = m.run(n_clients=4, n_rounds=4)
+    assert out["poisoned_median_err"] < 1.0 < out["poisoned_mean_err"]
+    assert out["fedbuff_err"] < 1.5
+    assert out["personalized_acc"] > out["global_acc"]

@@ -134,3 +134,25 @@ def test_fedbuff_guards_mesh(setup):
     sim, *_ = setup
     with pytest.raises(ValueError):
         FedBuff(FedSim(sim.model, batch_size=16, mesh=make_mesh(8)))
+
+
+def test_fedper_robust_excludes_zero_sample_clients(setup, nprng):
+    """A robust FedPer round with half the cohort at n_samples=0 must
+    aggregate over real participants only — zero-sample clients' shared
+    leaves are the unchanged broadcast and would drag the median to a
+    no-op (review fix, mirrors engine.py's robust branch)."""
+    sim, params, data, n_samples = setup
+    sim_med = FedSim(sim.model, batch_size=16, learning_rate=0.1,
+                     aggregator="median")
+    fp = FedPer(sim_med, personal=_head)
+    n0 = np.asarray(n_samples).copy()
+    n0[2:] = 0  # only clients 0,1 have data
+    res = fp.run_round(params, None, data, jnp.asarray(n0),
+                       jax.random.key(4), n_epochs=2)
+    # shared leaves moved: the median was NOT pinned to the broadcast
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(res.params),
+                        jax.tree_util.tree_leaves(params))
+    )
+    assert moved
