@@ -575,6 +575,98 @@ class FedSim:
             out["accuracy"] = totals["correct_sum"] / denom
         return out
 
+    @partial(jax.jit, static_argnums=(0,))
+    def _eval_sums_per_client(self, params, data, n_samples, rngs):
+        def one(d, n, r):
+            return client_eval_sums(self.model, params, d, n, r)
+
+        return jax.vmap(one)(data, n_samples, rngs)  # [C]-leaved sums
+
+    def evaluate_clients(
+        self,
+        params: Params,
+        data: Dict[str, jax.Array],
+        n_samples: jax.Array,
+        rng: Optional[jax.Array] = None,
+        wave_size: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Per-client evaluation + a fairness summary.
+
+        The federation-wide mean (:meth:`evaluate_round`) hides exactly
+        what non-IID federations care about: how unevenly the global
+        model serves individual clients. Returns ``per_client`` arrays
+        (loss, accuracy when defined, n — NaN for zero-sample clients)
+        and a ``fairness`` block with mean/std plus direction-aware tail
+        stats — ``worst`` and ``worst_decile`` are min/p10 for accuracy
+        but max/p90 for loss, so they always describe the struggling
+        clients. Waved and mesh-sharded like :meth:`evaluate_round`.
+        """
+        if rng is None:
+            rng = jax.random.key(0)
+        n_samples = jnp.asarray(n_samples)
+        c = int(n_samples.shape[0])
+        rngs = jax.random.split(rng, c)
+        n_dev = self._clients_per_wave_unit()
+        wave = round_up(wave_size if wave_size is not None else c, n_dev)
+        in_shard = client_sharding(self.mesh) if self.mesh is not None else None
+
+        parts = []
+        for start in range(0, c, wave):
+            stop = min(start + wave, c)
+            d = jax.tree_util.tree_map(lambda a: a[start:stop], data)
+            n = n_samples[start:stop]
+            r = rngs[start:stop]
+            d, n, r = self._pad_wave(d, n, r, wave)
+            if in_shard is not None:
+                # same client-sharded placement as evaluate_round: the
+                # vmapped forward partitions over the mesh via GSPMD
+                d = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, in_shard), d
+                )
+                n = jax.device_put(n, in_shard)
+                r = jax.device_put(r, in_shard)
+            sums = self._eval_sums_per_client(params, d, n, r)
+            parts.append(jax.tree_util.tree_map(
+                lambda a: np.asarray(a[: stop - start]), sums
+            ))
+        sums = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0), *parts
+        )
+
+        n_arr = sums["n"]
+        valid = n_arr > 0
+        denom = np.where(valid, n_arr, 1.0)
+        per_client: Dict[str, Any] = {
+            "loss": np.where(valid, sums["loss_sum"] / denom, np.nan),
+            "n": n_arr,
+        }
+        metric = "loss"
+        if "correct_sum" in sums:
+            per_client["accuracy"] = np.where(
+                valid, sums["correct_sum"] / denom, np.nan
+            )
+            metric = "accuracy"
+        vals = per_client[metric][valid]
+        # direction-aware tail: "worst" must mean the struggling clients
+        # whichever the metric — min/p10 for accuracy, max/p90 for loss
+        higher_is_better = metric == "accuracy"
+        if vals.size:
+            worst = float(np.min(vals) if higher_is_better else np.max(vals))
+            worst_decile = float(
+                np.percentile(vals, 10 if higher_is_better else 90)
+            )
+        else:
+            worst = worst_decile = float("nan")
+        fairness = {
+            "metric": metric,
+            "mean": float(np.mean(vals)) if vals.size else float("nan"),
+            "std": float(np.std(vals)) if vals.size else float("nan"),
+            "worst": worst,
+            "worst_decile": worst_decile,
+            "n_clients": int(valid.sum()),
+        }
+        return {"per_client": per_client, "fairness": fairness}
+
     # ------------------------------------------------------------------
     def run_rounds(
         self,

@@ -261,3 +261,30 @@ def test_robust_aggregator_on_mesh_matches_single_device(linear_setup):
             np.asarray(r_mesh.params[k]), np.asarray(r_one.params[k]),
             rtol=1e-5, atol=1e-6,
         )
+
+
+def test_evaluate_clients_fairness(linear_setup):
+    """Per-client eval: weighted recombination matches evaluate_round,
+    zero-sample clients are NaN, fairness block is consistent."""
+    model, params, data, n_samples = linear_setup
+    sim = FedSim(model, batch_size=32, learning_rate=0.01)
+    n0 = np.asarray(n_samples).copy()
+    n0[3] = 0  # client 3 contributes nothing
+    out = sim.evaluate_clients(params, data, jnp.asarray(n0),
+                               jax.random.key(0), wave_size=3)
+    pc = out["per_client"]
+    assert pc["loss"].shape == (8,)
+    assert np.isnan(pc["loss"][3]) and np.isfinite(pc["loss"][0])
+    # example-weighted recombination == the aggregate eval
+    agg_eval = sim.evaluate_round(params, data, jnp.asarray(n0),
+                                  jax.random.key(0))
+    valid = pc["n"] > 0
+    recombined = float(np.sum(pc["loss"][valid] * pc["n"][valid])
+                       / np.sum(pc["n"][valid]))
+    np.testing.assert_allclose(recombined, agg_eval["loss"], rtol=1e-5)
+    f = out["fairness"]
+    assert f["n_clients"] == 7 and f["metric"] == "loss"
+    # loss: "worst" is the HIGHEST loss (direction-aware tail)
+    assert f["worst"] == float(np.nanmax(pc["loss"]))
+    assert f["worst_decile"] <= f["worst"]
+    assert f["worst"] >= f["mean"]
