@@ -107,17 +107,35 @@ class Experiment:
         secure_agg: bool = False,
         secure_scale_bits: int = 16,
         aggregator: str = "mean",
+        cohort_fraction: float = 1.0,
+        min_cohort: int = 1,
     ):
         """``aggregator``: ``"mean"`` (sample-weighted FedAvg, reference
         manager.py:119-126), or Byzantine-robust ``"trimmed:<ratio>"`` /
         ``"median"`` (coordinate-wise order statistics over the round's
         reporters, unweighted — a poisoned client must not buy influence
-        via a claimed n_samples; ops/aggregation.py)."""
+        via a claimed n_samples; ops/aggregation.py).
+
+        ``cohort_fraction``: the FedAvg paper's C — each round samples
+        this fraction of registered clients (at least ``min_cohort``)
+        for notification instead of broadcasting to everyone (the
+        reference's only mode, manager.py:77-86). Unsampled clients
+        simply skip the round; their next heartbeat keeps them
+        registered."""
         if secure_agg and allow_pickle:
             raise ValueError(
                 "secure_agg is incompatible with allow_pickle: reference-"
                 "protocol pickle workers cannot speak the masking protocol"
             )
+        if not (0.0 < cohort_fraction <= 1.0):
+            raise ValueError(
+                f"cohort_fraction must be in (0, 1], got {cohort_fraction}"
+            )
+        self.cohort_fraction = cohort_fraction
+        self.min_cohort = max(1, int(min_cohort))
+        import random as _random
+
+        self._cohort_rng = _random.Random(rng_seed)
         self.aggregator = agg.parse_aggregator(aggregator)
         if secure_agg and self.aggregator[0] != "mean":
             raise ValueError(
@@ -334,6 +352,17 @@ class Experiment:
             # aborted attempt that reuses this round name) — folding it
             # in would add uncancellable mask noise
             return web.json_response({"error": "Not In Cohort"}, status=410)
+        if client_id not in self.rounds.clients:
+            # never client_start'ed this round: an unsampled registered
+            # client (cohort_fraction < 1) or a straggler from an aborted
+            # attempt reusing the round name. Deliberate deviation from
+            # the reference (which records any authenticated upload,
+            # manager.py:105-107): counting an outsider would skew the
+            # mean AND trip clients_left to 0 early, ending the round
+            # before sampled participants report.
+            return web.json_response(
+                {"error": "Not A Participant"}, status=410
+            )
         if compressed_anchor is not None:
             # reconstruct AFTER the round checks: the anchor (this
             # round's broadcast == self.params, unchanged until
@@ -425,6 +454,7 @@ class Experiment:
             return {}
         state_dict = params_to_state_dict(self.params)
         meta = {"update_name": round_name, "n_epoch": n_epoch}
+        cohort_ids = self._sample_cohort()
         if self.secure_agg:
             # Bonawitz round 0 (AdvertiseKeys): per-round DH key
             # agreement. Clients that fail are excluded BEFORE the pk
@@ -432,7 +462,7 @@ class Experiment:
             pk_results = await asyncio.gather(
                 *[
                     self._collect_pk(cid, round_name)
-                    for cid in list(self.registry.clients)
+                    for cid in cohort_ids
                 ]
             )
             pks = {cid: p for cid, p in pk_results if p is not None}
@@ -514,7 +544,7 @@ class Experiment:
                 m["secure"] = dict(meta["secure"], inbox=inbox)
                 bodies[cid] = wire.encode(state_dict, m)
         else:
-            recipients = list(self.registry.clients)
+            recipients = cohort_ids
             bodies = {cid: body for cid in recipients}
         self._broadcasting = True
         try:
@@ -545,6 +575,17 @@ class Experiment:
         # broadcast window — settle the round now
         self._maybe_finish()
         return dict(results)
+
+    def _sample_cohort(self) -> list:
+        """The round's notification cohort: all registered clients at
+        ``cohort_fraction=1`` (reference behavior), else a uniform sample
+        of ``max(min_cohort, fraction * N)`` without replacement."""
+        ids = list(self.registry.clients)
+        if self.cohort_fraction >= 1.0 or len(ids) <= self.min_cohort:
+            return ids
+        k = min(len(ids), max(self.min_cohort,
+                              int(round(self.cohort_fraction * len(ids)))))
+        return sorted(self._cohort_rng.sample(ids, k))
 
     async def _secure_post(self, client_id: str, endpoint: str, payload: dict):
         """POST a secure-protocol message to one worker; None on any

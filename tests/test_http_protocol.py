@@ -533,3 +533,115 @@ def test_byzantine_worker_defeated_by_median_aggregator():
             await r.cleanup()
 
     run(main())
+
+
+def test_cohort_fraction_samples_subset_per_round():
+    """FedAvg-paper C-fraction sampling: with cohort_fraction=0.5 over 4
+    workers, each round notifies exactly 2; unsampled workers skip the
+    round; the federation still converges; different rounds draw
+    different cohorts."""
+
+    async def main():
+        model = linear_regression_model(10)
+        nprng = np.random.default_rng(12)
+        mport = free_port()
+        mapp = web.Application()
+        manager = Manager(mapp)
+        exp = manager.register_experiment(
+            model, name="coh", round_timeout=60.0, cohort_fraction=0.5
+        )
+        mrunner = web.AppRunner(mapp)
+        await mrunner.setup()
+        await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+        runners, workers = [mrunner], []
+        shared = make_local_trainer(model, batch_size=32, learning_rate=0.02)
+        for _ in range(4):
+            data = linear_client_data(nprng, min_batches=2, max_batches=2)
+            wport = free_port()
+            wapp = web.Application()
+            w = ExperimentWorker(wapp, model, f"127.0.0.1:{mport}",
+                                 name="coh", port=wport, heartbeat_time=30.0,
+                                 trainer=shared,
+                                 get_data=lambda d=data: (d, d["x"].shape[0]))
+            wrunner = web.AppRunner(wapp)
+            await wrunner.setup()
+            await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+            workers.append(w)
+            runners.append(wrunner)
+
+        for _ in range(200):
+            if len(exp.registry) == 4:
+                break
+            await asyncio.sleep(0.05)
+        assert len(exp.registry) == 4
+
+        import aiohttp
+
+        cohorts = []
+        async with aiohttp.ClientSession() as session:
+            for _ in range(8):
+                async with session.get(
+                    f"http://127.0.0.1:{mport}/coh/start_round?n_epoch=4"
+                ) as resp:
+                    assert resp.status == 200
+                    acks = await resp.json()
+                assert len(acks) == 2 and all(acks.values()), acks
+                cohorts.append(frozenset(acks))
+                for _ in range(200):
+                    if not exp.rounds.in_progress:
+                        break
+                    await asyncio.sleep(0.05)
+                assert not exp.rounds.in_progress
+
+        # sampling actually varies across rounds (seeded rng, 8 draws
+        # of 2-of-4: all-identical has probability (1/6)^7)
+        assert len(set(cohorts)) > 1
+        # total updates across workers == 8 rounds x 2 sampled
+        assert sum(w.n_updates for w in workers) == 16
+        np.testing.assert_allclose(
+            np.asarray(exp.params["w"]).ravel(), DEMO_COEF, atol=2.0
+        )
+
+        for r in runners:
+            await r.cleanup()
+
+    run(main())
+
+
+def test_unsampled_client_upload_rejected_410():
+    """An authenticated client OUTSIDE the round's cohort must not be
+    able to inject an upload (it would skew the mean and end the round
+    early) — 410 Not A Participant."""
+
+    async def main():
+        client, exp = await _manager_client()
+        resp = await client.get("/exp/register", json={"port": 1})
+        a = await resp.json()
+        resp = await client.get("/exp/register", json={"port": 2})
+        b = await resp.json()
+
+        exp.rounds.start_round(n_epoch=1)
+        exp.rounds.client_start(a["client_id"])  # only A participates
+
+        body = wire.encode(
+            params_to_state_dict(exp.params),
+            {"update_name": exp.rounds.round_name, "n_samples": 5,
+             "loss_history": [1.0]},
+        )
+        resp = await client.post(
+            f"/exp/update?client_id={b['client_id']}&key={b['key']}",
+            data=body,
+        )
+        assert resp.status == 410
+        assert exp.rounds.in_progress  # round NOT consumed by the outsider
+
+        resp = await client.post(
+            f"/exp/update?client_id={a['client_id']}&key={a['key']}",
+            data=body,
+        )
+        assert resp.status == 200
+        assert not exp.rounds.in_progress
+        await client.close()
+
+    run(main())
