@@ -29,7 +29,7 @@ sparse support set would itself leak which coordinates changed.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -215,3 +215,40 @@ def decompress_payload(payload: Params, template: Params) -> Params:
         undo, payload, is_leaf=lambda x: isinstance(x, dict) and "idx" in x
     )
     return topk_decompress(payload, template)
+
+
+# ---------------------------------------------------------------------------
+# downlink (broadcast) quantization — the server->client half of the
+# bandwidth story. The manager quantizes the round's state dict once per
+# round; every cohort member dequantizes the SAME tensors, so all clients
+# still start from identical params (which also keeps secure-aggregation
+# and sparse-upload anchors consistent).
+
+
+def quantize_state_dict(
+    state: Dict[str, Any], seed: int, bits: int = 8
+) -> Dict[str, Any]:
+    """Flat wire layout: ``{"<name>@q": intN, "<name>@qscale": f32[1]}``.
+    Stochastic rounding (unbiased) seeded per round."""
+    q = quantize_stochastic(dict(state), jax.random.key(seed), bits=bits)
+    out: Dict[str, Any] = {}
+    for k, p in q.items():
+        out[f"{k}@q"] = p["q"]
+        out[f"{k}@qscale"] = jnp.asarray([p["scale"]], jnp.float32)
+    return out
+
+
+def dequantize_state_dict(tensors: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`quantize_state_dict` (accepts numpy or jnp)."""
+    import numpy as np
+
+    out: Dict[str, Any] = {}
+    for k in tensors:
+        if not k.endswith("@q"):
+            continue
+        name = k[: -len("@q")]
+        scale = float(np.asarray(tensors[f"{name}@qscale"]).ravel()[0])
+        out[name] = np.asarray(tensors[k], np.float32) * scale
+    if not out:
+        raise ValueError("no quantized tensors found in payload")
+    return out

@@ -109,6 +109,7 @@ class Experiment:
         aggregator: str = "mean",
         cohort_fraction: float = 1.0,
         min_cohort: int = 1,
+        broadcast_quantize_bits: Optional[int] = None,
     ):
         """``aggregator``: ``"mean"`` (sample-weighted FedAvg, reference
         manager.py:119-126), or Byzantine-robust ``"trimmed:<ratio>"`` /
@@ -121,12 +122,28 @@ class Experiment:
         for notification instead of broadcasting to everyone (the
         reference's only mode, manager.py:77-86). Unsampled clients
         simply skip the round; their next heartbeat keeps them
-        registered."""
+        registered.
+
+        ``broadcast_quantize_bits`` (8 or 16): downlink compression —
+        each round's broadcast ships stochastically-quantized weights
+        (ops/compression.py::quantize_state_dict), 4x/2x smaller on the
+        wire. All cohort members dequantize the SAME tensors, so every
+        client still starts from identical params, and sparse uplink
+        deltas are reconstructed against the dequantized anchor."""
         if secure_agg and allow_pickle:
             raise ValueError(
                 "secure_agg is incompatible with allow_pickle: reference-"
                 "protocol pickle workers cannot speak the masking protocol"
             )
+        if broadcast_quantize_bits not in (None, 8, 16):
+            raise ValueError("broadcast_quantize_bits must be None, 8 or 16")
+        if broadcast_quantize_bits is not None and allow_pickle:
+            raise ValueError(
+                "broadcast quantization is incompatible with allow_pickle: "
+                "reference-protocol workers cannot dequantize"
+            )
+        self.broadcast_quantize_bits = broadcast_quantize_bits
+        self._broadcast_anchor_sd: Optional[dict] = None
         if not (0.0 < cohort_fraction <= 1.0):
             raise ValueError(
                 f"cohort_fraction must be in (0, 1], got {cohort_fraction}"
@@ -326,8 +343,13 @@ class Experiment:
                         status=400,
                     )
                 # one device-to-host materialization per upload, shared
-                # by validation and reconstruction below
-                compressed_anchor = params_to_state_dict(self.params)
+                # by validation and reconstruction below; under a
+                # quantized broadcast the anchor is what clients LOADED
+                compressed_anchor = (
+                    self._broadcast_anchor_sd
+                    if self._broadcast_anchor_sd is not None
+                    else params_to_state_dict(self.params)
+                )
                 self._validate_compressed_upload(tensors, compressed_anchor)
             elif self.secure_agg:
                 self._validate_masked_upload(tensors, meta)
@@ -454,6 +476,32 @@ class Experiment:
             return {}
         state_dict = params_to_state_dict(self.params)
         meta = {"update_name": round_name, "n_epoch": n_epoch}
+        if self.broadcast_quantize_bits is not None:
+            from baton_tpu.ops.compression import (
+                dequantize_state_dict,
+                quantize_state_dict,
+            )
+
+            bits = self.broadcast_quantize_bits
+            state_dict = {
+                k: np.asarray(v)
+                for k, v in quantize_state_dict(
+                    state_dict, seed=self.rounds.n_rounds, bits=bits
+                ).items()
+            }
+            meta["quantized"] = {"bits": bits}
+            # sparse uplink deltas are computed against what the clients
+            # actually LOADED — the dequantized broadcast ROUND-TRIPPED
+            # through the model's param dtypes (state_dict_to_params
+            # casts each leaf; skipping that cast would leave the anchor
+            # off by an ulp per coordinate for non-f32 params)
+            self._broadcast_anchor_sd = params_to_state_dict(
+                state_dict_to_params(
+                    self.params, dequantize_state_dict(state_dict)
+                )
+            )
+        else:
+            self._broadcast_anchor_sd = None
         cohort_ids = self._sample_cohort()
         if self.secure_agg:
             # Bonawitz round 0 (AdvertiseKeys): per-round DH key

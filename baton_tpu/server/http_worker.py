@@ -391,6 +391,12 @@ class ExperimentWorker:
             )
             round_name = meta["update_name"]
             n_epoch = int(meta["n_epoch"])
+            if meta.get("quantized"):
+                # downlink-compressed broadcast (manager
+                # broadcast_quantize_bits): reconstruct dense weights
+                from baton_tpu.ops.compression import dequantize_state_dict
+
+                tensors = dequantize_state_dict(tensors)
             new_params = state_dict_to_params(self.params, tensors)
         except Exception:
             # reject before mutating any state: a bad broadcast must not

@@ -445,3 +445,114 @@ def test_manager_rejects_unknown_compression_scheme():
         await client.close()
 
     asyncio.run(main())
+
+
+def test_quantized_broadcast_federation_converges():
+    """Downlink compression (broadcast_quantize_bits=16) composed with
+    sparse uplink deltas: the federation still converges — and the
+    manager reconstructs uplink deltas against the DEQUANTIZED anchor
+    (what clients actually loaded), which at frac=1.0 makes the
+    round-trip exact."""
+    import asyncio
+
+    from aiohttp import web
+
+    from baton_tpu.core.training import make_local_trainer
+    from baton_tpu.data.synthetic import DEMO_COEF, linear_client_data
+    from baton_tpu.models.linear import linear_regression_model
+    from baton_tpu.server.http_manager import Manager
+    from baton_tpu.server.http_worker import ExperimentWorker
+    from baton_tpu.server.state import params_to_state_dict
+
+    def free_port():
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    async def main():
+        model = linear_regression_model(10)
+        nprng = np.random.default_rng(6)
+        mport = free_port()
+        mapp = web.Application()
+        manager = Manager(mapp)
+        exp = manager.register_experiment(
+            model, name="dq", round_timeout=60.0, broadcast_quantize_bits=16
+        )
+        mrunner = web.AppRunner(mapp)
+        await mrunner.setup()
+        await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+        runners, workers = [mrunner], []
+        shared = make_local_trainer(model, batch_size=32, learning_rate=0.02)
+        for spec in (None, "topk:1.0"):
+            data = linear_client_data(nprng, min_batches=2, max_batches=2)
+            wport = free_port()
+            wapp = web.Application()
+            w = ExperimentWorker(wapp, model, f"127.0.0.1:{mport}",
+                                 name="dq", port=wport, heartbeat_time=30.0,
+                                 trainer=shared, compress=spec,
+                                 get_data=lambda d=data: (d, d["x"].shape[0]))
+            wrunner = web.AppRunner(wapp)
+            await wrunner.setup()
+            await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+            workers.append(w)
+            runners.append(wrunner)
+
+        for _ in range(200):
+            if len(exp.registry) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(exp.registry) == 2
+
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            for _ in range(8):
+                async with session.get(
+                    f"http://127.0.0.1:{mport}/dq/start_round?n_epoch=4"
+                ) as resp:
+                    assert resp.status == 200
+                for _ in range(200):
+                    if not exp.rounds.in_progress:
+                        break
+                    await asyncio.sleep(0.05)
+                assert not exp.rounds.in_progress
+
+        # the frac=1.0 compressed worker's final upload reconstructed
+        # exactly (anchor = dequantized broadcast)
+        got = exp.rounds.client_responses
+        w1 = workers[1]
+        sd1 = {k: np.asarray(v, np.float32)
+               for k, v in params_to_state_dict(w1.params).items()}
+        for k in sd1:
+            np.testing.assert_allclose(got[w1.client_id]["state_dict"][k],
+                                       sd1[k], atol=1e-4)
+
+        np.testing.assert_allclose(
+            np.asarray(exp.params["w"]).ravel(), DEMO_COEF, atol=2.0
+        )
+        for r in runners:
+            await r.cleanup()
+
+    asyncio.run(main())
+
+
+def test_broadcast_quantize_rejects_pickle_combo():
+    from aiohttp import web
+
+    from baton_tpu.models.linear import linear_regression_model
+    from baton_tpu.server.http_manager import Manager
+
+    manager = Manager(web.Application())
+    with pytest.raises(ValueError):
+        manager.register_experiment(
+            linear_regression_model(4), name="x", allow_pickle=True,
+            broadcast_quantize_bits=8, start_background_tasks=False,
+        )
+    with pytest.raises(ValueError):
+        manager.register_experiment(
+            linear_regression_model(4), name="y",
+            broadcast_quantize_bits=12, start_background_tasks=False,
+        )
