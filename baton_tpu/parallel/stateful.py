@@ -1,0 +1,151 @@
+"""Stateful clients — per-client optimizer state persisting across rounds.
+
+Cross-DEVICE FedAvg resets each client's optimizer every round by design
+(clients are anonymous and stateless — the engine's default, matching
+the reference where a worker's ``train()`` builds a fresh optimizer each
+call, reference demo.py:29-34). Cross-SILO federations are different:
+the same few institutions participate every round, and letting each keep
+its local Adam/momentum moments across rounds is the standard refinement
+— local curvature information survives the round boundary.
+
+TPU-first shape: the cohort's optimizer states live as ONE stacked
+pytree ``[C, ...]`` (the same layout as client data and FedPer's
+personal stack), so a round is a single vmapped dispatch of
+``LocalTrainer.train_with_opt_state`` over (state, data, rng); trained
+params aggregate with the sim's configured rule (mean / trimmed /
+median) and a FedOpt server optimizer composes on top exactly as in the
+synchronous engine. The caller owns the stack — checkpoint it next to
+the globals to resume a federation with its optimizer memory intact.
+
+Memory: C x optimizer state (≈ C x params for Adam) — the inherent cost
+of statefulness, same scale as robust aggregation's stacked params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from baton_tpu.ops import aggregation as agg
+from baton_tpu.parallel.engine import FedSim, _server_update
+
+Params = Any
+
+
+@dataclasses.dataclass
+class StatefulRoundResult:
+    params: Params
+    opt_states: Params          # [C, ...] stacked, threads to next round
+    loss_history: jax.Array     # [n_epochs] sample-weighted
+    client_losses: jax.Array    # [C, n_epochs]
+    server_opt_state: Any = None
+
+
+class StatefulClients:
+    """Synchronous rounds with persistent per-client optimizer state.
+
+    Wraps a :class:`FedSim` (same model/trainer/aggregator config); use
+    the sim's own ``run_round`` when clients should stay stateless.
+    """
+
+    def __init__(self, sim: FedSim):
+        if sim.trainable_predicate is not None:
+            raise ValueError(
+                "StatefulClients threads full-param optimizer state; "
+                "compose with LoRA by building the FedSim on the adapter "
+                "pytree directly"
+            )
+        if sim.mesh is not None:
+            raise ValueError(
+                "StatefulClients dispatches a single-device vmap; a mesh-"
+                "configured FedSim would silently run unsharded — use a "
+                "meshless FedSim"
+            )
+        self.sim = sim
+        self._jit_cache: Dict[int, Any] = {}
+
+    def init_opt_states(self, params: Params, n_clients: int) -> Params:
+        """Stacked optimizer states, one per client, all initialized from
+        the same global params."""
+        opt0 = self.sim.trainer.optimizer.init(params)
+        return jax.tree_util.tree_map(
+            lambda l: jnp.broadcast_to(
+                jnp.asarray(l), (n_clients,) + jnp.shape(l)
+            ),
+            opt0,
+        )
+
+    def _round_fn(self, n_epochs: int):
+        if n_epochs not in self._jit_cache:
+            trainer = self.sim.trainer
+            with_anchor = trainer.regularizer is not None
+
+            def round_fn(params, opt_states, data, n_samples, rngs):
+                def one(os, d, n, r):
+                    new_p, new_os, losses = trainer.train_with_opt_state(
+                        params, os, d, n, r, n_epochs,
+                        params if with_anchor else None,
+                    )
+                    return new_p, new_os, losses
+
+                return jax.vmap(one)(opt_states, data, n_samples, rngs)
+
+            self._jit_cache[n_epochs] = jax.jit(round_fn)
+        return self._jit_cache[n_epochs]
+
+    def run_round(
+        self,
+        params: Params,
+        opt_states: Optional[Params],
+        data: Dict[str, jax.Array],
+        n_samples: jax.Array,
+        rng: jax.Array,
+        n_epochs: int = 1,
+        server_opt_state=None,
+    ) -> StatefulRoundResult:
+        n_samples = jnp.asarray(n_samples)
+        c = int(n_samples.shape[0])
+        if opt_states is None:
+            opt_states = self.init_opt_states(params, c)
+        rngs = jax.random.split(rng, c)
+
+        trained, new_opt_states, closs = self._round_fn(n_epochs)(
+            params, opt_states, data, n_samples, rngs
+        )
+
+        w = n_samples.astype(jnp.float32)
+        if self.sim.aggregator[0] != "mean":
+            keep = np.flatnonzero(np.asarray(n_samples) > 0)
+            if keep.size == 0:
+                keep = np.arange(c)
+            kept = jax.tree_util.tree_map(
+                lambda a: jnp.take(a, jnp.asarray(keep), axis=0), trained
+            )
+            aggregate = agg.apply_aggregator(self.sim.aggregator, kept, None)
+        else:
+            aggregate = agg.apply_aggregator(self.sim.aggregator, trained, w)
+        aggregate = jax.tree_util.tree_map(
+            lambda m, ref: jnp.asarray(m).astype(jnp.asarray(ref).dtype),
+            aggregate, params,
+        )
+
+        if self.sim.server_optimizer is not None:
+            if server_opt_state is None:
+                server_opt_state = self.sim.server_optimizer.init(params)
+            new_params, server_opt_state = _server_update(
+                self.sim.server_optimizer, params, aggregate, server_opt_state
+            )
+        else:
+            new_params = aggregate
+
+        return StatefulRoundResult(
+            params=new_params,
+            opt_states=new_opt_states,
+            loss_history=agg.weighted_scalar_mean(closs, w),
+            client_losses=closs,
+            server_opt_state=server_opt_state,
+        )

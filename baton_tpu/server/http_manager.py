@@ -751,13 +751,22 @@ class Experiment:
             self.metrics.set_gauge("sim_wave", done)
             self.metrics.set_gauge("sim_waves_total", total)
 
+        # under a quantized broadcast every participant must start from
+        # the identical dequantized weights — including the in-process
+        # simulated cohort, which never crosses the wire
+        start_params = (
+            state_dict_to_params(self.params, self._broadcast_anchor_sd)
+            if self._broadcast_anchor_sd is not None
+            else self.params
+        )
+
         def run():
             # reset BOTH gauges: a stale total from the previous round
             # would render "0 of <old total>" until the first wave lands
             self.metrics.set_gauge("sim_wave", 0)
             self.metrics.set_gauge("sim_waves_total", 0)
             return self.simulator.run_round(
-                self.params,
+                start_params,
                 args["data"],
                 args["n_samples"],
                 jax.random.key(self.rounds.n_rounds),

@@ -556,3 +556,69 @@ def test_broadcast_quantize_rejects_pickle_combo():
             linear_regression_model(4), name="y",
             broadcast_quantize_bits=12, start_background_tasks=False,
         )
+
+
+def test_simulated_cohort_starts_from_dequantized_anchor():
+    """With broadcast_quantize_bits set, the in-process simulated cohort
+    must train from the SAME dequantized weights HTTP clients load —
+    not the manager's exact params (review fix)."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from baton_tpu.data.synthetic import linear_client_data
+    from baton_tpu.models.linear import linear_regression_model
+    from baton_tpu.ops.padding import stack_client_datasets
+    from baton_tpu.parallel.engine import FedSim
+    from baton_tpu.server.http_manager import Manager
+    from baton_tpu.server.state import state_dict_to_params
+
+    async def main():
+        model = linear_regression_model(10)
+        nprng = np.random.default_rng(8)
+        datasets = [linear_client_data(nprng, min_batches=2, max_batches=2)
+                    for _ in range(3)]
+        data, n_samples = stack_client_datasets(datasets, batch_size=32)
+        data = {k: jnp.asarray(v) for k, v in data.items()}
+
+        app = web.Application()
+        manager = Manager(app)
+        exp = manager.register_experiment(
+            model, name="sq", round_timeout=60.0,
+            broadcast_quantize_bits=8, start_background_tasks=False,
+        )
+        sim = FedSim(model, batch_size=32, learning_rate=0.02)
+        exp.attach_simulator(sim, data, n_samples)
+
+        seen_start = {}
+        orig = sim.run_round
+
+        def spy(params, *a, **kw):
+            seen_start["params"] = params
+            return orig(params, *a, **kw)
+
+        sim.run_round = spy
+
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        resp = await client.get("/sq/start_round?n_epoch=1")
+        assert resp.status == 200
+        for _ in range(400):
+            if not exp.rounds.in_progress:
+                break
+            await asyncio.sleep(0.05)
+        assert not exp.rounds.in_progress
+
+        # the cohort's start params are the dequantized anchor, not the
+        # exact pre-quantization globals
+        want = state_dict_to_params(exp.params, exp._broadcast_anchor_sd)
+        got = seen_start["params"]
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(want)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        await client.close()
+
+    asyncio.run(main())
