@@ -188,3 +188,34 @@ def apply_aggregator(spec, stacked: Params, weights: jax.Array) -> Params:
     if spec[0] == "median":
         return coordinate_median(stacked)
     return weighted_tree_mean(stacked, weights)
+
+
+def aggregate_stacked(
+    spec, stacked: Params, n_samples: jax.Array, like: Params
+) -> Params:
+    """Combine ``[C, ...]``-stacked client params into one tree shaped/
+    dtyped like ``like``, honoring a :func:`parse_aggregator` spec.
+
+    The one shared round-combine tail (engine robust branch,
+    StatefulClients, FedPer): for robust rules, zero-sample clients are
+    excluded first — their "update" is the unchanged broadcast and
+    enough of them would pull the order statistic to a no-op round; the
+    weighted mean needs no exclusion (weight 0 contributes 0).
+    """
+    import numpy as np
+
+    w = jnp.asarray(n_samples).astype(jnp.float32)
+    if spec[0] != "mean":
+        keep = np.flatnonzero(np.asarray(n_samples) > 0)
+        if keep.size == 0:
+            keep = np.arange(int(w.shape[0]))
+        stacked = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, jnp.asarray(keep), axis=0), stacked
+        )
+        merged = apply_aggregator(spec, stacked, None)
+    else:
+        merged = apply_aggregator(spec, stacked, w)
+    return jax.tree_util.tree_map(
+        lambda m, ref: jnp.asarray(m).astype(jnp.asarray(ref).dtype),
+        merged, like,
+    )

@@ -2,6 +2,7 @@ from baton_tpu.parallel.mesh import make_mesh, client_sharding, replicated_shard
 from baton_tpu.parallel.engine import FedSim, RoundResult
 from baton_tpu.parallel.fedbuff import AsyncResult, FedBuff
 from baton_tpu.parallel.personalization import FedPer, PersonalizedRoundResult
+from baton_tpu.parallel.clustered import ClusteredFedSim, ClusteredRoundResult
 from baton_tpu.parallel.stateful import StatefulClients, StatefulRoundResult
 from baton_tpu.parallel.ring_attention import (
     ring_attention,
@@ -28,6 +29,8 @@ __all__ = [
     "PersonalizedRoundResult",
     "StatefulClients",
     "StatefulRoundResult",
+    "ClusteredFedSim",
+    "ClusteredRoundResult",
     "ring_attention",
     "ulysses_attention",
     "make_ring_attention_fn",

@@ -471,20 +471,8 @@ class FedSim:
             stacked = jax.tree_util.tree_map(
                 lambda *xs: jnp.concatenate(xs, axis=0), *stacked_parts
             )
-            # order statistics over REAL participants only: zero-sample
-            # clients never trained (their update is the unchanged
-            # broadcast) and would bias the trim/median toward no-op
-            keep = np.flatnonzero(np.asarray(n_samples) > 0)
-            if keep.size == 0:
-                # nobody trained: the round is a no-op, like the
-                # reference's zero-accepting-clients auto-end
-                keep = np.arange(int(n_samples.shape[0]))
-            stacked = jax.tree_util.tree_map(
-                lambda a: jnp.take(a, jnp.asarray(keep), axis=0), stacked
-            )
-            merged = agg.apply_aggregator(self.aggregator, stacked, None)
-            aggregate = jax.tree_util.tree_map(
-                lambda m, ref: m.astype(ref.dtype), merged, params
+            aggregate = agg.aggregate_stacked(
+                self.aggregator, stacked, n_samples, params
             )
         else:
             aggregate = jax.tree_util.tree_map(

@@ -137,24 +137,9 @@ class FedPer:
         )
 
         w = n_samples.astype(jnp.float32)
-        if self.sim.aggregator[0] != "mean":
-            # order statistics over REAL participants only (mirrors the
-            # engine's robust branch): a zero-sample client's shared
-            # leaves are the unchanged broadcast, and enough of them
-            # would pull the trim/median toward a no-op round
-            keep = np.flatnonzero(np.asarray(n_samples) > 0)
-            if keep.size == 0:
-                keep = np.arange(c)
-            kept_shared = jax.tree_util.tree_map(
-                lambda a: jnp.take(a, jnp.asarray(keep), axis=0), new_shared
-            )
-            shared_agg = agg.apply_aggregator(
-                self.sim.aggregator, kept_shared, None
-            )
-        else:
-            shared_agg = agg.apply_aggregator(
-                self.sim.aggregator, new_shared, w
-            )
+        shared_agg = agg.aggregate_stacked(
+            self.sim.aggregator, new_shared, n_samples, shared
+        )
         # warm start for future clients: unweighted mean of personal leaves
         pers_mean = jax.tree_util.tree_map(
             lambda l: jnp.mean(l.astype(jnp.float32), axis=0).astype(l.dtype),

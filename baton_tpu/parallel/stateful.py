@@ -118,19 +118,8 @@ class StatefulClients:
         )
 
         w = n_samples.astype(jnp.float32)
-        if self.sim.aggregator[0] != "mean":
-            keep = np.flatnonzero(np.asarray(n_samples) > 0)
-            if keep.size == 0:
-                keep = np.arange(c)
-            kept = jax.tree_util.tree_map(
-                lambda a: jnp.take(a, jnp.asarray(keep), axis=0), trained
-            )
-            aggregate = agg.apply_aggregator(self.sim.aggregator, kept, None)
-        else:
-            aggregate = agg.apply_aggregator(self.sim.aggregator, trained, w)
-        aggregate = jax.tree_util.tree_map(
-            lambda m, ref: jnp.asarray(m).astype(jnp.asarray(ref).dtype),
-            aggregate, params,
+        aggregate = agg.aggregate_stacked(
+            self.sim.aggregator, trained, n_samples, params
         )
 
         if self.sim.server_optimizer is not None:

@@ -1,0 +1,106 @@
+"""Clustered FL (parallel/clustered.py, IFCA-style).
+
+Oracle scenario: clients drawn from TWO linear populations with
+different true coefficient vectors. K=2 clustering must (a) separate the
+populations in its assignments, (b) recover BOTH coefficient vectors,
+while (c) a single global FedAvg model fits neither.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.ops.padding import stack_client_datasets
+from baton_tpu.parallel.clustered import ClusteredFedSim
+from baton_tpu.parallel.engine import FedSim
+
+COEF_A = np.array([5, -3, 2, 8, -1, 4, 0, 7, -6, 2], np.float32)
+COEF_B = -COEF_A
+
+
+def _mixture(nprng, n_per_pop=4, n=64):
+    datasets, pops = [], []
+    for pop, coef in ((0, COEF_A), (1, COEF_B)):
+        for _ in range(n_per_pop):
+            x = nprng.normal(size=(n, 10)).astype(np.float32)
+            y = x @ coef + 0.1 * nprng.normal(size=n).astype(np.float32)
+            datasets.append({"x": x, "y": y.astype(np.float32)})
+            pops.append(pop)
+    return datasets, np.asarray(pops)
+
+
+@pytest.fixture
+def setup(nprng):
+    datasets, pops = _mixture(nprng)
+    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    sim = FedSim(linear_regression_model(10), batch_size=32,
+                 learning_rate=0.05)
+    return sim, data, jnp.asarray(n_samples), pops
+
+
+def test_ifca_separates_populations_and_recovers_both(setup):
+    sim, data, n_samples, pops = setup
+    cf = ClusteredFedSim(sim, n_clusters=2)
+    clusters = cf.init_clusters(jax.random.key(0))
+    for r in range(12):
+        res = cf.run_round(clusters, data, n_samples,
+                           jax.random.fold_in(jax.random.key(1), r),
+                           n_epochs=2)
+        clusters = res.cluster_params
+
+    # (a) assignments are exactly the populations (up to label swap)
+    a = res.assignments
+    same = np.all(a == pops) or np.all(a == 1 - pops)
+    assert same, (a, pops)
+
+    # (b) both coefficient vectors recovered by their clusters
+    w = np.asarray(clusters["w"]).reshape(2, -1)
+    k_a = a[0]  # cluster that population A landed in
+    err_a = np.max(np.abs(w[k_a] - COEF_A))
+    err_b = np.max(np.abs(w[1 - k_a] - COEF_B))
+    assert err_a < 0.5 and err_b < 0.5, (err_a, err_b)
+
+    # (c) a single global model fits neither population
+    p = sim.init(jax.random.key(0))
+    for r in range(12):
+        p = sim.run_round(p, data, n_samples,
+                          jax.random.fold_in(jax.random.key(1), r),
+                          n_epochs=2).params
+    w_glob = np.asarray(p["w"]).ravel()
+    assert np.max(np.abs(w_glob - COEF_A)) > 2.0
+    assert np.max(np.abs(w_glob - COEF_B)) > 2.0
+
+    # clustered eval is far better than global eval
+    loss_cluster = cf.evaluate(clusters, data, n_samples)["loss"]
+    loss_global = sim.evaluate_round(p, data, n_samples)["loss"]
+    assert loss_cluster < loss_global * 0.1, (loss_cluster, loss_global)
+
+
+def test_empty_cluster_keeps_params(setup):
+    """A cluster that attracts no clients must keep its previous params
+    (not collapse to zeros/NaNs)."""
+    sim, data, n_samples, _ = setup
+    cf = ClusteredFedSim(sim, n_clusters=3)  # 3 clusters, 2 populations
+    clusters = cf.init_clusters(jax.random.key(5))
+    res = cf.run_round(clusters, data, n_samples, jax.random.key(6),
+                       n_epochs=1)
+    used = set(res.assignments.tolist())
+    if len(used) < 3:  # at least one empty cluster this round
+        empty = next(k for k in range(3) if k not in used)
+        np.testing.assert_array_equal(
+            np.asarray(res.cluster_params["w"])[empty],
+            np.asarray(clusters["w"])[empty],
+        )
+    assert np.all(np.isfinite(np.asarray(res.cluster_params["w"])))
+
+
+def test_guards(setup):
+    sim, *_ = setup
+    with pytest.raises(ValueError):
+        ClusteredFedSim(sim, n_clusters=1)
+    with pytest.raises(ValueError):
+        ClusteredFedSim(FedSim(sim.model, batch_size=32,
+                               aggregator="median"), n_clusters=2)
