@@ -3,7 +3,11 @@ from baton_tpu.data.synthetic import (
     synthetic_char_clients,
     synthetic_classification_clients,
 )
-from baton_tpu.data.partition import iid_partition, dirichlet_partition
+from baton_tpu.data.partition import (
+    dirichlet_partition,
+    iid_partition,
+    label_shard_partition,
+)
 from baton_tpu.data.datasets import (
     ByteTokenizer,
     DatasetUnavailable,
@@ -18,6 +22,7 @@ __all__ = [
     "synthetic_classification_clients",
     "iid_partition",
     "dirichlet_partition",
+    "label_shard_partition",
     "ByteTokenizer",
     "DatasetUnavailable",
     "load_ag_news",

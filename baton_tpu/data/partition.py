@@ -67,3 +67,38 @@ def partition_stats(shards: List[Dict[str, np.ndarray]], label_key: str = "y"):
         vals, counts = np.unique(y, return_counts=True)
         stats.append({"n": int(y.shape[0]), "labels": dict(zip(vals.tolist(), counts.tolist()))})
     return stats
+
+
+def label_shard_partition(
+    data: Dict[str, np.ndarray],
+    n_clients: int,
+    rng: np.random.Generator,
+    classes_per_client: int = 2,
+    label_key: str = "y",
+) -> List[Dict[str, np.ndarray]]:
+    """The FedAvg paper's "pathological non-IID" split: sort by label,
+    cut into ``n_clients * classes_per_client`` equal shards, deal each
+    client ``classes_per_client`` shards — so most clients see only a
+    couple of classes. Harsher than a Dirichlet skew; the classic
+    stress test for aggregation/personalization methods."""
+    if classes_per_client < 1:
+        raise ValueError("classes_per_client must be >= 1")
+    y = np.asarray(data[label_key])
+    n = len(y)
+    n_shards = n_clients * classes_per_client
+    if n_shards > n:
+        raise ValueError(
+            f"{n_shards} shards requested from {n} samples"
+        )
+    # sort by label with a random tie-break so repeated calls differ
+    order = np.lexsort((rng.random(n), y))
+    shard_bounds = np.linspace(0, n, n_shards + 1).astype(int)
+    shard_ids = rng.permutation(n_shards)
+    out: List[Dict[str, np.ndarray]] = []
+    for c in range(n_clients):
+        mine = shard_ids[c * classes_per_client:(c + 1) * classes_per_client]
+        idx = np.concatenate(
+            [order[shard_bounds[s]:shard_bounds[s + 1]] for s in mine]
+        )
+        out.append({k: np.asarray(v)[idx] for k, v in data.items()})
+    return out

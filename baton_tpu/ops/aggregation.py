@@ -25,6 +25,7 @@ from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Params = Any
 
@@ -202,8 +203,6 @@ def aggregate_stacked(
     enough of them would pull the order statistic to a no-op round; the
     weighted mean needs no exclusion (weight 0 contributes 0).
     """
-    import numpy as np
-
     w = jnp.asarray(n_samples).astype(jnp.float32)
     if spec[0] != "mean":
         keep = np.flatnonzero(np.asarray(n_samples) > 0)

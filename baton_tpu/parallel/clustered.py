@@ -47,6 +47,17 @@ class ClusteredRoundResult:
     client_losses: jax.Array    # [C, n_epochs]
 
 
+def _masked_mean_loss(model, p, d, n, r):
+    """One client's masked mean loss under ``p`` — the single assignment
+    rule used both in rounds and at eval time (they must agree, or a
+    client would train one cluster and be scored with another)."""
+    losses = model.per_example_loss(p, d, r)
+    mask = (jnp.arange(losses.shape[0]) < n).astype(jnp.float32)
+    return jnp.sum(losses.astype(jnp.float32) * mask) / jnp.maximum(
+        mask.sum(), 1.0
+    )
+
+
 class ClusteredFedSim:
     """IFCA rounds over a :class:`FedSim`'s trainer."""
 
@@ -91,16 +102,9 @@ class ClusteredFedSim:
                 # -- 1. assignment: masked mean loss of every cluster on
                 # every client's data ------------------------------------
                 def client_losses_vs_clusters(d, n, r):
-                    def one_cluster(p):
-                        losses = model.per_example_loss(p, d, r)
-                        mask = (
-                            jnp.arange(losses.shape[0]) < n
-                        ).astype(jnp.float32)
-                        return jnp.sum(
-                            losses.astype(jnp.float32) * mask
-                        ) / jnp.maximum(mask.sum(), 1.0)
-
-                    return jax.vmap(one_cluster)(cluster_params)  # [K]
+                    return jax.vmap(
+                        lambda p: _masked_mean_loss(model, p, d, n, r)
+                    )(cluster_params)  # [K]
 
                 grid = jax.vmap(client_losses_vs_clusters)(
                     data, n_samples, rngs
@@ -180,28 +184,33 @@ class ClusteredFedSim:
     ) -> Dict[str, float]:
         """Each client scored with its best-fitting cluster (fresh
         assignment) — the federation-wide example-weighted aggregate."""
-        from baton_tpu.parallel.engine import client_eval_sums
-
         if rng is None:
             rng = jax.random.key(0)
         n_samples = jnp.asarray(n_samples)
         c = int(n_samples.shape[0])
         rngs = jax.random.split(rng, c)
+        totals = self._eval_fn()(cluster_params, data, n_samples, rngs)
+        denom = max(float(totals["n"]), 1.0)
+        out = {"loss": float(totals["loss_sum"]) / denom, "n": denom}
+        if "correct_sum" in totals:
+            out["accuracy"] = float(totals["correct_sum"]) / denom
+        return out
+
+    def _eval_fn(self):
+        # cached like _round_fn (and FedPer._eval_fn): a fresh jit per
+        # call would recompile the identical C x K eval program each time
+        if "eval" in self._jit_cache:
+            return self._jit_cache["eval"]
+        from baton_tpu.parallel.engine import client_eval_sums
+
         model = self.sim.model
 
         @jax.jit
         def eval_all(cluster_params, data, n_samples, rngs):
             def one(d, n, r):
-                def loss_of(p):
-                    losses = model.per_example_loss(p, d, r)
-                    mask = (
-                        jnp.arange(losses.shape[0]) < n
-                    ).astype(jnp.float32)
-                    return jnp.sum(
-                        losses.astype(jnp.float32) * mask
-                    ) / jnp.maximum(mask.sum(), 1.0)
-
-                k = jnp.argmin(jax.vmap(loss_of)(cluster_params))
+                k = jnp.argmin(jax.vmap(
+                    lambda p: _masked_mean_loss(model, p, d, n, r)
+                )(cluster_params))
                 mine = jax.tree_util.tree_map(
                     lambda a: a[k], cluster_params
                 )
@@ -210,9 +219,5 @@ class ClusteredFedSim:
             sums = jax.vmap(one)(data, n_samples, rngs)
             return jax.tree_util.tree_map(jnp.sum, sums)
 
-        totals = eval_all(cluster_params, data, n_samples, rngs)
-        denom = max(float(totals["n"]), 1.0)
-        out = {"loss": float(totals["loss_sum"]) / denom, "n": denom}
-        if "correct_sum" in totals:
-            out["accuracy"] = float(totals["correct_sum"]) / denom
-        return out
+        self._jit_cache["eval"] = eval_all
+        return eval_all

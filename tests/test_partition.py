@@ -60,3 +60,34 @@ def test_dirichlet_is_more_skewed_than_iid(nprng):
         return np.mean(ents)
 
     assert mean_label_entropy(noniid) < mean_label_entropy(iid) - 0.5
+
+
+def test_label_shard_partition_is_pathological(nprng):
+    """FedAvg-paper split: every sample lands exactly once, and most
+    clients see at most classes_per_client distinct labels."""
+    from baton_tpu.data.partition import label_shard_partition
+
+    n, k = 400, 10
+    data = {
+        "x": nprng.normal(size=(n, 4)).astype(np.float32),
+        "y": nprng.integers(0, k, size=n).astype(np.int32),
+    }
+    shards = label_shard_partition(data, n_clients=10, rng=nprng,
+                                   classes_per_client=2)
+    assert len(shards) == 10
+    # exact cover: every row exactly once
+    all_x = np.concatenate([s["x"] for s in shards])
+    assert all_x.shape[0] == n
+    assert len({tuple(r) for r in np.round(all_x, 6)}) == n
+    # pathological skew: each of a client's 2 shards straddles at most 2
+    # labels (contiguous in sorted order), so the hard bound is 4 — far
+    # below the 10 classes an IID client would see
+    n_labels = [len(np.unique(s["y"])) for s in shards]
+    assert max(n_labels) <= 4
+    assert np.mean(n_labels) <= 4.0, n_labels
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        label_shard_partition(data, n_clients=300, rng=nprng,
+                              classes_per_client=2)
