@@ -117,3 +117,4 @@ def test_advanced_aggregation():
     assert out["poisoned_median_err"] < 1.0 < out["poisoned_mean_err"]
     assert out["fedbuff_err"] < 1.5
     assert out["personalized_acc"] > out["global_acc"]
+    assert out["clusters_separated"] and out["clustered_loss"] < 1.0
