@@ -49,6 +49,12 @@ _ALLOWED_DTYPES = {
 
 
 def _np_dtype(name: str):
+    # whitelist BEFORE np.dtype: an attacker-controlled header string
+    # must not reach the dtype constructor (arbitrary names raise
+    # TypeError past the 400 path, and exotic dtypes like 'V<n>'/'object'
+    # have no business on this wire)
+    if name not in _ALLOWED_DTYPES:
+        raise ValueError(f"disallowed tensor dtype {name!r}")
     if name == "bfloat16":
         import ml_dtypes
 
@@ -81,22 +87,52 @@ def encode(tensors: Mapping[str, np.ndarray], meta: Dict[str, Any]) -> bytes:
 
 
 def decode(data: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
-    """Parse BTW1 bytes → (tensors, meta). No code execution."""
+    """Parse BTW1 bytes → (tensors, meta). No code execution.
+
+    Contract for attacker-controlled input: any malformed payload —
+    truncated, bit-flipped, wrong lengths — raises ``ValueError`` (or a
+    ``json``/``Key``/``Index`` error the server's 400 path equally
+    catches); never anything that escapes a standard except clause, and
+    never interpretation of the bytes as code (fuzzed in
+    tests/test_wire.py)."""
     if data[:4] != MAGIC:
         raise ValueError("not a BTW1 payload")
-    (hdr_len,) = struct.unpack("<I", data[4:8])
+    try:
+        (hdr_len,) = struct.unpack("<I", data[4:8])
+    except struct.error as e:
+        raise ValueError(f"truncated BTW1 header: {e}") from e
     header = json.loads(data[8 : 8 + hdr_len].decode("utf-8"))
+    # explicit structural validation: a crafted VALID-JSON header with
+    # wrong types (null tensors, float shapes, string offsets) must hit
+    # the same ValueError contract as corrupt bytes, not leak TypeError/
+    # AttributeError past it
+    if not isinstance(header, dict) or not isinstance(
+        header.get("tensors"), dict
+    ):
+        raise ValueError("BTW1 header is not {tensors: {...}}")
     body = memoryview(data)[8 + hdr_len :]
     tensors: Dict[str, np.ndarray] = {}
-    names = list(header["tensors"].items())
-    for i, (name, info) in enumerate(names):
-        dtype = _np_dtype(info["dtype"])
-        shape = tuple(info["shape"])
+    for name, info in header["tensors"].items():
+        if not isinstance(info, dict):
+            raise ValueError(f"bad tensor entry for {name!r}")
+        dtype = _np_dtype(info.get("dtype"))
+        shape = info.get("shape")
+        offset = info.get("offset")
+        if (
+            not isinstance(shape, list)
+            or not all(isinstance(s, int) and s >= 0 for s in shape)
+            or not isinstance(offset, int)
+            or offset < 0
+        ):
+            raise ValueError(f"bad shape/offset for {name!r}")
+        shape = tuple(shape)
         nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if shape else dtype.itemsize
-        start = info["offset"]
-        arr = np.frombuffer(body[start : start + nbytes], dtype=dtype).reshape(shape)
+        arr = np.frombuffer(body[offset : offset + nbytes], dtype=dtype).reshape(shape)
         tensors[name] = arr
-    return tensors, header.get("meta", {})
+    meta = header.get("meta", {})
+    if not isinstance(meta, dict):
+        raise ValueError("BTW1 meta is not a dict")
+    return tensors, meta
 
 
 def decode_any(

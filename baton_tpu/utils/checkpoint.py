@@ -30,6 +30,7 @@ class RestoredState:
     params: Params
     server_opt_state: Any
     meta: dict
+    extra: Any = None
 
 
 class Checkpointer:
@@ -61,7 +62,12 @@ class Checkpointer:
         server_opt_state: Any = None,
         meta: Optional[dict] = None,
         wait: bool = True,
+        extra: Any = None,
     ) -> None:
+        """``extra`` is any additional pytree riding the checkpoint —
+        the slot for federation-mode state the globals don't capture:
+        a FedPer personal stack, StatefulClients optimizer states, or
+        ClusteredFedSim cluster params (all plain pytrees)."""
         ocp = self._ocp
         items = {
             "params": ocp.args.StandardSave(params),
@@ -69,6 +75,8 @@ class Checkpointer:
         }
         if server_opt_state is not None:
             items["server_opt"] = ocp.args.StandardSave(server_opt_state)
+        if extra is not None:
+            items["extra"] = ocp.args.StandardSave(extra)
         self._mngr.save(step, args=ocp.args.Composite(**items))
         if wait:
             self._mngr.wait_until_finished()
@@ -100,6 +108,7 @@ class Checkpointer:
         params_template: Params,
         server_opt_template: Any = None,
         step: Optional[int] = None,
+        extra_template: Any = None,
     ) -> Optional[RestoredState]:
         """Restore ``step`` (default: latest). Returns None when the
         directory holds no checkpoints — callers fall through to fresh
@@ -114,19 +123,23 @@ class Checkpointer:
             "params": ocp.args.StandardRestore(params_template),
             "meta": ocp.args.JsonRestore(),
         }
-        if server_opt_template is not None and "server_opt" in self._saved_items(step):
+        saved = self._saved_items(step)
+        if server_opt_template is not None and "server_opt" in saved:
             # Only request server_opt when the checkpoint actually holds
             # one — e.g. the HTTP manager's end_round never saves server
             # optimizer state, and pointing a FedOpt-configured run at
             # such a checkpoint must fall back to fresh optimizer state,
             # not raise.
             items["server_opt"] = ocp.args.StandardRestore(server_opt_template)
+        if extra_template is not None and "extra" in saved:
+            items["extra"] = ocp.args.StandardRestore(extra_template)
         restored = self._mngr.restore(step, args=ocp.args.Composite(**items))
         return RestoredState(
             step=step,
             params=restored["params"],
             server_opt_state=restored.get("server_opt"),
             meta=restored["meta"] or {},
+            extra=restored.get("extra"),
         )
 
     # ------------------------------------------------------------------

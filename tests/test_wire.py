@@ -110,3 +110,79 @@ def test_running_mean_is_exact():
     for v in [4.0, 2.0, 6.0]:
         rm.update(v)
     assert rm.mean == pytest.approx(4.0)  # reference's biased mean gave 4.75
+
+
+def test_decode_survives_fuzzed_bytes(nprng):
+    """Security posture: decode() of attacker-controlled bytes must only
+    ever raise clean exceptions (never crash the process, never hang,
+    never execute anything) — 400-path material for the server. Fuzz:
+    truncations, bit flips, and random garbage over a real payload."""
+    tensors = {
+        "w": nprng.normal(size=(4, 3)).astype(np.float32),
+        "b": nprng.normal(size=(3,)).astype(np.float16),
+        "i": nprng.integers(0, 100, size=(5,)).astype(np.int32),
+    }
+    payload = bytearray(wire.encode(tensors, {"update_name": "u", "n": 1}))
+
+    attempts = 0
+    for cut in range(0, len(payload), max(1, len(payload) // 40)):
+        attempts += 1
+        try:
+            wire.decode(bytes(payload[:cut]))
+        except Exception as e:
+            assert isinstance(e, (ValueError, KeyError, IndexError,
+                                  EOFError, UnicodeDecodeError)), repr(e)
+    for _ in range(300):
+        attempts += 1
+        mutated = bytearray(payload)
+        for _ in range(int(nprng.integers(1, 8))):
+            pos = int(nprng.integers(0, len(mutated)))
+            mutated[pos] = int(nprng.integers(0, 256))
+        try:
+            t, m = wire.decode(bytes(mutated))
+            # decoded without error: must still be a sane dict of arrays
+            assert isinstance(t, dict) and isinstance(m, dict)
+            for v in t.values():
+                np.asarray(v)
+        except Exception as e:
+            assert isinstance(e, (ValueError, KeyError, IndexError,
+                                  EOFError, UnicodeDecodeError)), repr(e)
+    for _ in range(100):
+        attempts += 1
+        junk = bytes(nprng.integers(0, 256, size=int(nprng.integers(0, 200)),
+                                    dtype=np.uint8))
+        try:
+            wire.decode(junk)
+        except Exception as e:
+            assert isinstance(e, (ValueError, KeyError, IndexError,
+                                  EOFError, UnicodeDecodeError)), repr(e)
+    # crafted VALID-JSON headers with wrong types: same clean contract
+    import json as _json
+    import struct as _struct
+
+    def craft(header_obj):
+        h = _json.dumps(header_obj).encode()
+        return b"BTW1" + _struct.pack("<I", len(h)) + h
+
+    crafted = [
+        craft(None),
+        craft({"tensors": None}),
+        craft({"tensors": {"w": None}}),
+        craft({"tensors": {"w": {"dtype": "float32", "shape": [4.3],
+                                 "offset": 0}}}),
+        craft({"tensors": {"w": {"dtype": "float32", "shape": [2],
+                                 "offset": "x"}}}),
+        craft({"tensors": {"w": {"dtype": "object", "shape": [2],
+                                 "offset": 0}}}),
+        craft({"tensors": {"w": {"dtype": "float32", "shape": [-2],
+                                 "offset": 0}}}),
+        craft({"tensors": {}, "meta": [1, 2]}),
+    ]
+    for c in crafted:
+        attempts += 1
+        try:
+            wire.decode(c)
+        except Exception as e:
+            assert isinstance(e, (ValueError, KeyError, IndexError,
+                                  EOFError, UnicodeDecodeError)), repr(e)
+    assert attempts > 400
