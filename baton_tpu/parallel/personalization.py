@@ -14,7 +14,10 @@ personal leaves with the replicated shared leaves, trains the full
 model, and splits the result; shared halves aggregate with the sim's
 configured rule (mean / trimmed / median via
 :func:`baton_tpu.ops.aggregation.apply_aggregator`), personal halves
-return as the new stack.
+return as the new stack. On a ``clients`` mesh the same body runs under
+``shard_map`` — personal stack and data sharded over chips, shared-leaf
+aggregation and the warm-start mean as psum collectives over ICI
+(numerically equal to the single-device round, tested).
 
 The returned global params carry the unweighted mean of the personal
 leaves purely as a warm start for clients joining later; it is never
@@ -67,12 +70,21 @@ class FedPer:
                 "the FedSim without one for personalized rounds"
             )
         if sim.mesh is not None:
-            raise ValueError(
-                "FedPer dispatches a single-device vmap; a mesh-"
-                "configured FedSim would silently run unsharded — use a "
-                "meshless FedSim (sharded personalization is a synchronous"
-                "-engine feature to request)"
-            )
+            from baton_tpu.parallel.tensor_parallel import MODEL_AXIS
+
+            if MODEL_AXIS in sim.mesh.axis_names:
+                raise ValueError(
+                    "FedPer shards the personal stack over the clients "
+                    "axis; the hybrid clients x model mesh is not "
+                    "supported here"
+                )
+            if sim.aggregator[0] != "mean":
+                raise ValueError(
+                    "sharded FedPer aggregates shared leaves with a "
+                    "psum mean; robust rules need the full stack on one "
+                    "device — use a meshless FedSim for robust "
+                    "personalized rounds"
+                )
         self.sim = sim
         self.personal_pred = personal
         self.partition = None
@@ -91,29 +103,96 @@ class FedPer:
             lambda l: jnp.broadcast_to(l, (n_clients,) + l.shape), personal
         )
 
+    def _train_local(self, n_epochs: int):
+        """The per-shard body shared by the vmap and shard_map paths."""
+        part = self.partition
+        trainer = self.sim.trainer
+        with_anchor = trainer.regularizer is not None
+
+        def train_local(personal_state, shared, data, n_samples, rngs):
+            def one(pers, d, n, r):
+                full = part.merge(pers, shared)
+                # the client's round-start params are its FedProx
+                # anchor (mirrors engine.py's wave kernels)
+                new_full, _, losses = trainer.train(
+                    full, d, n, r, n_epochs,
+                    full if with_anchor else None,
+                )
+                new_pers, new_shared = part.split(new_full)
+                return new_pers, new_shared, losses
+
+            return jax.vmap(one)(personal_state, data, n_samples, rngs)
+
+        return train_local
+
     def _round_fn(self, n_epochs: int):
         if n_epochs not in self._jit_cache:
-            part = self.partition
-            trainer = self.sim.trainer
-
-            with_anchor = trainer.regularizer is not None
-
-            def round_fn(personal_state, shared, data, n_samples, rngs):
-                def one(pers, d, n, r):
-                    full = part.merge(pers, shared)
-                    # the client's round-start params are its FedProx
-                    # anchor (mirrors engine.py's wave kernels)
-                    new_full, _, losses = trainer.train(
-                        full, d, n, r, n_epochs,
-                        full if with_anchor else None,
-                    )
-                    new_pers, new_shared = part.split(new_full)
-                    return new_pers, new_shared, losses
-
-                return jax.vmap(one)(personal_state, data, n_samples, rngs)
-
-            self._jit_cache[n_epochs] = jax.jit(round_fn)
+            self._jit_cache[n_epochs] = jax.jit(self._train_local(n_epochs))
         return self._jit_cache[n_epochs]
+
+    def _round_fn_sharded(self, n_epochs: int):
+        """Mesh path: personal stack / data / rngs sharded over the
+        clients axis, shared leaves replicated; shared aggregation and
+        the warm-start personal mean are psum collectives over ICI —
+        the same layout rule as the engine's sharded wave kernel."""
+        key = ("sharded", n_epochs)
+        if key not in self._jit_cache:
+            from jax.sharding import PartitionSpec as P
+
+            from baton_tpu.parallel.mesh import CLIENT_AXIS
+
+            train_local = self._train_local(n_epochs)
+
+            def kernel(personal_state, shared, data, n_samples, rngs):
+                new_pers, new_shared, closs = train_local(
+                    personal_state, shared, data, n_samples, rngs
+                )
+                w = n_samples.astype(jnp.float32)
+                # shared-leaf FedAvg: the one shared psum rule
+                shared_f32 = agg.psum_weighted_mean(new_shared, w,
+                                                    CLIENT_AXIS)
+                shared_agg = jax.tree_util.tree_map(
+                    lambda s, ref: s.astype(jnp.asarray(ref).dtype),
+                    shared_f32, shared,
+                )
+                # warm start: mean over REAL clients only — phantom
+                # zero-sample rows carry unchanged round-start leaves
+                # and would bias the mean toward no-op
+                m = (n_samples > 0).astype(jnp.float32)
+                pers_sum = jax.lax.psum(
+                    jax.tree_util.tree_map(
+                        lambda l: jnp.tensordot(
+                            m, l.astype(jnp.float32), axes=(0, 0)
+                        ),
+                        new_pers,
+                    ),
+                    CLIENT_AXIS,
+                )
+                n_real = jnp.maximum(
+                    jax.lax.psum(jnp.sum(m), CLIENT_AXIS), 1.0
+                )
+                pers_mean = jax.tree_util.tree_map(
+                    lambda s, ref: (s / n_real).astype(ref.dtype),
+                    pers_sum, personal_state,
+                )
+                wtot = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
+                lsum = jax.lax.psum(
+                    jnp.tensordot(w, closs.astype(jnp.float32),
+                                  axes=(0, 0)),
+                    CLIENT_AXIS,
+                )
+                loss_hist = lsum / jnp.maximum(wtot, 1e-9)
+                return new_pers, shared_agg, pers_mean, loss_hist, closs
+
+            self._jit_cache[key] = jax.jit(jax.shard_map(
+                kernel,
+                mesh=self.sim.mesh,
+                in_specs=(P(CLIENT_AXIS), P(), P(CLIENT_AXIS),
+                          P(CLIENT_AXIS), P(CLIENT_AXIS)),
+                out_specs=(P(CLIENT_AXIS), P(), P(), P(), P(CLIENT_AXIS)),
+                check_vma=False,
+            ))
+        return self._jit_cache[key]
 
     def run_round(
         self,
@@ -132,6 +211,35 @@ class FedPer:
         _, shared = self.partition.split(params)
         rngs = jax.random.split(rng, c)
 
+        if self.sim.mesh is not None:
+            from baton_tpu.parallel.mesh import CLIENT_AXIS, client_sharding
+
+            n_dev = int(self.sim.mesh.shape[CLIENT_AXIS])
+            if c % n_dev:
+                raise ValueError(
+                    f"sharded FedPer needs the cohort ({c}) divisible by "
+                    f"the clients mesh axis ({n_dev}); pad with "
+                    "zero-sample clients (ops/padding) — padded rows are "
+                    "excluded from the warm-start personal mean"
+                )
+            shard = client_sharding(self.sim.mesh)
+            put = lambda t: jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, shard), t
+            )
+            new_pers, shared_agg, pers_mean, loss_history, closs = (
+                self._round_fn_sharded(n_epochs)(
+                    put(personal_state), shared, put(data),
+                    jax.device_put(n_samples, shard),
+                    jax.device_put(rngs, shard),
+                )
+            )
+            return PersonalizedRoundResult(
+                params=self.partition.merge(pers_mean, shared_agg),
+                personal_state=new_pers,
+                loss_history=loss_history,
+                client_losses=closs,
+            )
+
         new_pers, new_shared, closs = self._round_fn(n_epochs)(
             personal_state, shared, data, n_samples, rngs
         )
@@ -140,9 +248,15 @@ class FedPer:
         shared_agg = agg.aggregate_stacked(
             self.sim.aggregator, new_shared, n_samples, shared
         )
-        # warm start for future clients: unweighted mean of personal leaves
+        # warm start for future clients: mean of REAL clients' personal
+        # leaves (zero-sample rows are unchanged broadcasts — excluding
+        # them keeps meshless and sharded rounds equal under padding)
+        m = (n_samples > 0).astype(jnp.float32)
+        n_real = jnp.maximum(jnp.sum(m), 1.0)
         pers_mean = jax.tree_util.tree_map(
-            lambda l: jnp.mean(l.astype(jnp.float32), axis=0).astype(l.dtype),
+            lambda l: (
+                jnp.tensordot(m, l.astype(jnp.float32), axes=(0, 0)) / n_real
+            ).astype(l.dtype),
             new_pers,
         )
         new_params = self.partition.merge(pers_mean, shared_agg)

@@ -118,15 +118,25 @@ def decode(data: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
         dtype = _np_dtype(info.get("dtype"))
         shape = info.get("shape")
         offset = info.get("offset")
+        # `type(..) is int` on purpose: bool is an int subclass and JSON
+        # true/false must not pass as dimensions/offsets
         if (
             not isinstance(shape, list)
-            or not all(isinstance(s, int) and s >= 0 for s in shape)
-            or not isinstance(offset, int)
+            or not all(type(s) is int and s >= 0 for s in shape)
+            or type(offset) is not int
             or offset < 0
         ):
             raise ValueError(f"bad shape/offset for {name!r}")
         shape = tuple(shape)
-        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize if shape else dtype.itemsize
+        # size math in unbounded Python ints, bounds-checked against the
+        # actual body BEFORE any numpy call — crafted huge dims must not
+        # reach C-long conversions (OverflowError escapes the contract)
+        count = 1
+        for s in shape:
+            count *= s
+        nbytes = count * dtype.itemsize
+        if offset + nbytes > len(body):
+            raise ValueError(f"tensor {name!r} extends past the payload")
         arr = np.frombuffer(body[offset : offset + nbytes], dtype=dtype).reshape(shape)
         tensors[name] = arr
     meta = header.get("meta", {})

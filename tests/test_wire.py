@@ -186,3 +186,28 @@ def test_decode_survives_fuzzed_bytes(nprng):
             assert isinstance(e, (ValueError, KeyError, IndexError,
                                   EOFError, UnicodeDecodeError)), repr(e)
     assert attempts > 400
+
+
+def test_decode_rejects_bool_and_huge_dims():
+    """Review regression: JSON true/false must not pass as ints, and
+    astronomically large dims must raise ValueError, not OverflowError."""
+    import json as _json
+    import struct as _struct
+
+    def craft(header_obj, body=b""):
+        h = _json.dumps(header_obj).encode()
+        return b"BTW1" + _struct.pack("<I", len(h)) + h + body
+
+    cases = [
+        craft({"tensors": {"w": {"dtype": "float32", "shape": [2 ** 70],
+                                 "offset": 0}}}),
+        craft({"tensors": {"w": {"dtype": "float32", "shape": [True],
+                                 "offset": 0}}}),
+        craft({"tensors": {"w": {"dtype": "float32", "shape": [2],
+                                 "offset": True}}}),
+        craft({"tensors": {"w": {"dtype": "float32", "shape": [4],
+                                 "offset": 0}}}, body=b"\x00" * 8),  # short
+    ]
+    for c in cases:
+        with pytest.raises(ValueError):
+            wire.decode(c)
