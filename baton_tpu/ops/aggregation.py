@@ -218,3 +218,26 @@ def aggregate_stacked(
         lambda m, ref: jnp.asarray(m).astype(jnp.asarray(ref).dtype),
         merged, like,
     )
+
+
+def psum_weighted_scalar_mean(
+    values: jax.Array, weights: jax.Array, axis_name: str
+) -> jax.Array:
+    """:func:`weighted_scalar_mean` across a sharded client axis — the
+    psum form used by the sharded FedPer/StatefulClients kernels (one
+    definition of the loss-history weighting, meshless or sharded)."""
+    w = weights.astype(jnp.float32)
+    lsum = jax.lax.psum(
+        jnp.tensordot(w, values.astype(jnp.float32), axes=(0, 0)), axis_name
+    )
+    wtot = jax.lax.psum(jnp.sum(w), axis_name)
+    return lsum / jnp.maximum(wtot, 1e-9)
+
+
+def tree_cast_like(tree: Params, like: Params) -> Params:
+    """Cast every leaf to the dtype of the corresponding ``like`` leaf
+    (the post-aggregation fp32 -> param-dtype step)."""
+    return jax.tree_util.tree_map(
+        lambda x, ref: jnp.asarray(x).astype(jnp.asarray(ref).dtype),
+        tree, like,
+    )

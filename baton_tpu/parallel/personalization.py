@@ -40,6 +40,20 @@ from baton_tpu.parallel.engine import FedSim, client_eval_sums
 Params = Any
 
 
+def _pad_stack(tree: Params, pad: int) -> Params:
+    """Pad a ``[C, ...]`` stacked pytree with ``pad`` copies of row 0 —
+    phantom rows' values never matter (masked training, weight 0,
+    excluded from means) but must be shape/dtype-valid."""
+    if pad <= 0:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda a: jnp.concatenate(
+            [a, jnp.repeat(a[:1], pad, axis=0)], axis=0
+        ),
+        tree,
+    )
+
+
 @dataclasses.dataclass
 class PersonalizedRoundResult:
     params: Params              # shared aggregated; personal leaves = warm-start mean
@@ -149,11 +163,9 @@ class FedPer:
                 )
                 w = n_samples.astype(jnp.float32)
                 # shared-leaf FedAvg: the one shared psum rule
-                shared_f32 = agg.psum_weighted_mean(new_shared, w,
-                                                    CLIENT_AXIS)
-                shared_agg = jax.tree_util.tree_map(
-                    lambda s, ref: s.astype(jnp.asarray(ref).dtype),
-                    shared_f32, shared,
+                shared_agg = agg.tree_cast_like(
+                    agg.psum_weighted_mean(new_shared, w, CLIENT_AXIS),
+                    shared,
                 )
                 # warm start: mean over REAL clients only — phantom
                 # zero-sample rows carry unchanged round-start leaves
@@ -175,13 +187,8 @@ class FedPer:
                     lambda s, ref: (s / n_real).astype(ref.dtype),
                     pers_sum, personal_state,
                 )
-                wtot = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
-                lsum = jax.lax.psum(
-                    jnp.tensordot(w, closs.astype(jnp.float32),
-                                  axes=(0, 0)),
-                    CLIENT_AXIS,
-                )
-                loss_hist = lsum / jnp.maximum(wtot, 1e-9)
+                loss_hist = agg.psum_weighted_scalar_mean(closs, w,
+                                                          CLIENT_AXIS)
                 return new_pers, shared_agg, pers_mean, loss_hist, closs
 
             self._jit_cache[key] = jax.jit(jax.shard_map(
@@ -212,32 +219,32 @@ class FedPer:
         rngs = jax.random.split(rng, c)
 
         if self.sim.mesh is not None:
-            from baton_tpu.parallel.mesh import CLIENT_AXIS, client_sharding
+            from baton_tpu.parallel.mesh import (
+                CLIENT_AXIS,
+                shard_client_arrays,
+            )
 
             n_dev = int(self.sim.mesh.shape[CLIENT_AXIS])
-            if c % n_dev:
-                raise ValueError(
-                    f"sharded FedPer needs the cohort ({c}) divisible by "
-                    f"the clients mesh axis ({n_dev}); pad with "
-                    "zero-sample clients (ops/padding) — padded rows are "
-                    "excluded from the warm-start personal mean"
-                )
-            shard = client_sharding(self.sim.mesh)
-            put = lambda t: jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, shard), t
+            target = -(-c // n_dev) * n_dev
+            # auto-pad with zero-weight phantoms like the engine's wave
+            # path (_pad_wave): phantoms train on all-masked data, carry
+            # FedAvg weight 0, and are excluded from the warm-start mean
+            data_p, n_p, rngs_p = self.sim._pad_wave(
+                data, n_samples, rngs, target
             )
+            pers_p = _pad_stack(personal_state, target - c)
+            put = lambda t: shard_client_arrays(t, self.sim.mesh)
             new_pers, shared_agg, pers_mean, loss_history, closs = (
                 self._round_fn_sharded(n_epochs)(
-                    put(personal_state), shared, put(data),
-                    jax.device_put(n_samples, shard),
-                    jax.device_put(rngs, shard),
+                    put(pers_p), shared, put(data_p), put(n_p), put(rngs_p)
                 )
             )
+            unpad = lambda t: jax.tree_util.tree_map(lambda a: a[:c], t)
             return PersonalizedRoundResult(
                 params=self.partition.merge(pers_mean, shared_agg),
-                personal_state=new_pers,
+                personal_state=unpad(new_pers),
                 loss_history=loss_history,
-                client_losses=closs,
+                client_losses=closs[:c],
             )
 
         new_pers, new_shared, closs = self._round_fn(n_epochs)(

@@ -14,8 +14,12 @@ personal stack), so a round is a single vmapped dispatch of
 ``LocalTrainer.train_with_opt_state`` over (state, data, rng); trained
 params aggregate with the sim's configured rule (mean / trimmed /
 median) and a FedOpt server optimizer composes on top exactly as in the
-synchronous engine. The caller owns the stack — checkpoint it next to
-the globals to resume a federation with its optimizer memory intact.
+synchronous engine. On a ``clients`` mesh the same body runs under
+``shard_map`` with the state stack sharded over chips and psum FedAvg
+over ICI (tested equal to the single-device rounds). The caller owns
+the stack — checkpoint it next to the globals (the Checkpointer's
+``extra`` slot) to resume a federation with its optimizer memory
+intact.
 
 Memory: C x optimizer state (≈ C x params for Adam) — the inherent cost
 of statefulness, same scale as robust aggregation's stacked params.
@@ -60,11 +64,21 @@ class StatefulClients:
                 "pytree directly"
             )
         if sim.mesh is not None:
-            raise ValueError(
-                "StatefulClients dispatches a single-device vmap; a mesh-"
-                "configured FedSim would silently run unsharded — use a "
-                "meshless FedSim"
-            )
+            from baton_tpu.parallel.tensor_parallel import MODEL_AXIS
+
+            if MODEL_AXIS in sim.mesh.axis_names:
+                raise ValueError(
+                    "StatefulClients shards the optimizer-state stack "
+                    "over the clients axis; the hybrid clients x model "
+                    "mesh is not supported here"
+                )
+            if sim.aggregator[0] != "mean":
+                raise ValueError(
+                    "sharded StatefulClients aggregates with a psum "
+                    "mean; robust rules need the full stack on one "
+                    "device — use a meshless FedSim for robust stateful "
+                    "rounds"
+                )
         self.sim = sim
         self._jit_cache: Dict[int, Any] = {}
 
@@ -79,23 +93,61 @@ class StatefulClients:
             opt0,
         )
 
+    def _train_local(self, n_epochs: int):
+        trainer = self.sim.trainer
+        with_anchor = trainer.regularizer is not None
+
+        def train_local(params, opt_states, data, n_samples, rngs):
+            def one(os, d, n, r):
+                new_p, new_os, losses = trainer.train_with_opt_state(
+                    params, os, d, n, r, n_epochs,
+                    params if with_anchor else None,
+                )
+                return new_p, new_os, losses
+
+            return jax.vmap(one)(opt_states, data, n_samples, rngs)
+
+        return train_local
+
     def _round_fn(self, n_epochs: int):
         if n_epochs not in self._jit_cache:
-            trainer = self.sim.trainer
-            with_anchor = trainer.regularizer is not None
-
-            def round_fn(params, opt_states, data, n_samples, rngs):
-                def one(os, d, n, r):
-                    new_p, new_os, losses = trainer.train_with_opt_state(
-                        params, os, d, n, r, n_epochs,
-                        params if with_anchor else None,
-                    )
-                    return new_p, new_os, losses
-
-                return jax.vmap(one)(opt_states, data, n_samples, rngs)
-
-            self._jit_cache[n_epochs] = jax.jit(round_fn)
+            self._jit_cache[n_epochs] = jax.jit(self._train_local(n_epochs))
         return self._jit_cache[n_epochs]
+
+    def _round_fn_sharded(self, n_epochs: int):
+        """Mesh path: the optimizer-state stack / data / rngs shard over
+        the clients axis, globals replicated; aggregation is the
+        engine's psum FedAvg over ICI (same layout rule as FedPer's
+        sharded round)."""
+        key = ("sharded", n_epochs)
+        if key not in self._jit_cache:
+            from jax.sharding import PartitionSpec as P
+
+            from baton_tpu.parallel.mesh import CLIENT_AXIS
+
+            train_local = self._train_local(n_epochs)
+
+            def kernel(params, opt_states, data, n_samples, rngs):
+                trained, new_os, closs = train_local(
+                    params, opt_states, data, n_samples, rngs
+                )
+                w = n_samples.astype(jnp.float32)
+                aggregate = agg.tree_cast_like(
+                    agg.psum_weighted_mean(trained, w, CLIENT_AXIS), params
+                )
+                loss_hist = agg.psum_weighted_scalar_mean(closs, w,
+                                                          CLIENT_AXIS)
+                return aggregate, new_os, loss_hist, closs
+
+            self._jit_cache[key] = jax.jit(jax.shard_map(
+                kernel,
+                mesh=self.sim.mesh,
+                in_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
+                          P(CLIENT_AXIS), P(CLIENT_AXIS)),
+                out_specs=(P(), P(CLIENT_AXIS), P(), P(CLIENT_AXIS)),
+                check_vma=False,
+            ))
+        return self._jit_cache[key]
 
     def run_round(
         self,
@@ -112,6 +164,49 @@ class StatefulClients:
         if opt_states is None:
             opt_states = self.init_opt_states(params, c)
         rngs = jax.random.split(rng, c)
+
+        if self.sim.mesh is not None:
+            from baton_tpu.parallel.mesh import (
+                CLIENT_AXIS,
+                shard_client_arrays,
+            )
+            from baton_tpu.parallel.personalization import _pad_stack
+
+            n_dev = int(self.sim.mesh.shape[CLIENT_AXIS])
+            target = -(-c // n_dev) * n_dev
+            # auto-pad with zero-weight phantoms like the engine's wave
+            # path; phantom optimizer states are row-0 copies that the
+            # all-masked training leaves untouched
+            data_p, n_p, rngs_p = self.sim._pad_wave(
+                data, n_samples, rngs, target
+            )
+            os_p = _pad_stack(opt_states, target - c)
+            put = lambda t: shard_client_arrays(t, self.sim.mesh)
+            aggregate, new_opt_states, loss_history, closs = (
+                self._round_fn_sharded(n_epochs)(
+                    params, put(os_p), put(data_p), put(n_p), put(rngs_p)
+                )
+            )
+            new_opt_states = jax.tree_util.tree_map(
+                lambda a: a[:c], new_opt_states
+            )
+            closs = closs[:c]
+            if self.sim.server_optimizer is not None:
+                if server_opt_state is None:
+                    server_opt_state = self.sim.server_optimizer.init(params)
+                new_params, server_opt_state = _server_update(
+                    self.sim.server_optimizer, params, aggregate,
+                    server_opt_state,
+                )
+            else:
+                new_params = aggregate
+            return StatefulRoundResult(
+                params=new_params,
+                opt_states=new_opt_states,
+                loss_history=loss_history,
+                client_losses=closs,
+                server_opt_state=server_opt_state,
+            )
 
         trained, new_opt_states, closs = self._round_fn(n_epochs)(
             params, opt_states, data, n_samples, rngs

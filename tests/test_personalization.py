@@ -191,11 +191,19 @@ def test_sharded_fedper_matches_single_device(nprng):
     np.testing.assert_allclose(np.asarray(r1.loss_history),
                                np.asarray(r8.loss_history), rtol=2e-5)
 
-    # indivisible cohort rejected with guidance
-    with pytest.raises(ValueError):
-        fp8.run_round(params, None,
-                      {k: v[:6] for k, v in data.items()},
-                      n_samples[:6], jax.random.key(3))
+    # indivisible cohorts auto-pad with phantoms and still match the
+    # meshless round on the same 6 clients
+    data6 = {k: v[:6] for k, v in data.items()}
+    n6 = n_samples[:6]
+    r1b = fp1.run_round(params, None, data6, n6, jax.random.key(3),
+                        n_epochs=1)
+    r8b = fp8.run_round(params, None, data6, n6, jax.random.key(3),
+                        n_epochs=1)
+    assert jax.tree_util.tree_leaves(r8b.personal_state)[0].shape[0] == 6
+    for a, b in zip(jax.tree_util.tree_leaves(r1b.params),
+                    jax.tree_util.tree_leaves(r8b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
 
 
 def test_sharded_fedper_with_phantom_padding_matches_unpadded(nprng):
