@@ -64,11 +64,26 @@ class ClusteredFedSim:
     def __init__(self, sim: FedSim, n_clusters: int):
         if n_clusters < 2:
             raise ValueError("clustering needs n_clusters >= 2")
-        if sim.trainable_predicate is not None or sim.mesh is not None:
+        if sim.trainable_predicate is not None:
             raise ValueError(
-                "ClusteredFedSim runs single-device vmap over full param "
-                "trees; use a meshless, partition-free FedSim"
+                "ClusteredFedSim trains full param trees; partitioned "
+                "sims are not supported"
             )
+        if sim.mesh is not None:
+            from baton_tpu.parallel.mesh import CLIENT_AXIS
+            from baton_tpu.parallel.tensor_parallel import MODEL_AXIS
+
+            if MODEL_AXIS in sim.mesh.axis_names:
+                raise ValueError(
+                    "ClusteredFedSim shards clients over the clients "
+                    "axis; the hybrid clients x model mesh is not "
+                    "supported here"
+                )
+            if CLIENT_AXIS not in sim.mesh.axis_names:
+                raise ValueError(
+                    f"mesh has axes {sim.mesh.axis_names} but sharded "
+                    f"clustering needs a {CLIENT_AXIS!r} axis"
+                )
         if sim.aggregator[0] != "mean":
             raise ValueError(
                 "per-cluster aggregation is the sample-weighted mean; "
@@ -91,67 +106,97 @@ class ClusteredFedSim:
         trees = [self.sim.model.init(k) for k in keys]
         return agg.tree_stack(trees)
 
+    def _assign_train_combine(self, n_epochs: int, psum_axis=None):
+        """The round body; with ``psum_axis`` the per-cluster sums
+        reduce across mesh shards (the sharded combine is the same math
+        with psums around the one-hot sums)."""
+        trainer = self.sim.trainer
+        model = self.sim.model
+        k_clusters = self.n_clusters
+        with_anchor = trainer.regularizer is not None
+
+        def round_fn(cluster_params, data, n_samples, rngs):
+            # -- 1. assignment: masked mean loss of every cluster on
+            # every client's data ------------------------------------
+            def client_losses_vs_clusters(d, n, r):
+                return jax.vmap(
+                    lambda p: _masked_mean_loss(model, p, d, n, r)
+                )(cluster_params)  # [K]
+
+            grid = jax.vmap(client_losses_vs_clusters)(
+                data, n_samples, rngs
+            )  # [C, K]
+            assign = jnp.argmin(grid, axis=1)  # [C]
+
+            # -- 2. train the chosen model per client ---------------
+            my_params = jax.tree_util.tree_map(
+                lambda a: jnp.take(a, assign, axis=0), cluster_params
+            )
+
+            def one(p, d, n, r):
+                new_p, _, losses = trainer.train(
+                    p, d, n, r, n_epochs, p if with_anchor else None
+                )
+                return new_p, losses
+
+            trained, closs = jax.vmap(one)(
+                my_params, data, n_samples, rngs
+            )
+
+            # -- 3. per-cluster sample-weighted mean via one-hot ----
+            w = n_samples.astype(jnp.float32)  # [C]
+            onehot = jax.nn.one_hot(assign, k_clusters)  # [C, K]
+            wk = onehot * w[:, None]  # [C, K]
+            denom = jnp.sum(wk, axis=0)  # [K]
+            if psum_axis is not None:
+                denom = jax.lax.psum(denom, psum_axis)
+
+            def combine(tr, old):
+                tr32 = tr.astype(jnp.float32)
+                sums = jnp.tensordot(wk, tr32, axes=(0, 0))  # [K, ...]
+                if psum_axis is not None:
+                    sums = jax.lax.psum(sums, psum_axis)
+                mean = sums / jnp.maximum(denom, 1e-9).reshape(
+                    (k_clusters,) + (1,) * (tr.ndim - 1)
+                )
+                keep_old = (denom <= 0).reshape(
+                    (k_clusters,) + (1,) * (tr.ndim - 1)
+                )
+                return jnp.where(
+                    keep_old, old.astype(jnp.float32), mean
+                ).astype(old.dtype)
+
+            new_clusters = jax.tree_util.tree_map(
+                combine, trained, cluster_params
+            )
+            return new_clusters, assign, closs
+
+        return round_fn
+
     def _round_fn(self, n_epochs: int):
         if n_epochs not in self._jit_cache:
-            trainer = self.sim.trainer
-            model = self.sim.model
-            k_clusters = self.n_clusters
-            with_anchor = trainer.regularizer is not None
-
-            def round_fn(cluster_params, data, n_samples, rngs):
-                # -- 1. assignment: masked mean loss of every cluster on
-                # every client's data ------------------------------------
-                def client_losses_vs_clusters(d, n, r):
-                    return jax.vmap(
-                        lambda p: _masked_mean_loss(model, p, d, n, r)
-                    )(cluster_params)  # [K]
-
-                grid = jax.vmap(client_losses_vs_clusters)(
-                    data, n_samples, rngs
-                )  # [C, K]
-                assign = jnp.argmin(grid, axis=1)  # [C]
-
-                # -- 2. train the chosen model per client ---------------
-                my_params = jax.tree_util.tree_map(
-                    lambda a: jnp.take(a, assign, axis=0), cluster_params
-                )
-
-                def one(p, d, n, r):
-                    new_p, _, losses = trainer.train(
-                        p, d, n, r, n_epochs, p if with_anchor else None
-                    )
-                    return new_p, losses
-
-                trained, closs = jax.vmap(one)(
-                    my_params, data, n_samples, rngs
-                )
-
-                # -- 3. per-cluster sample-weighted mean via one-hot ----
-                w = n_samples.astype(jnp.float32)  # [C]
-                onehot = jax.nn.one_hot(assign, k_clusters)  # [C, K]
-                wk = onehot * w[:, None]  # [C, K]
-                denom = jnp.sum(wk, axis=0)  # [K]
-
-                def combine(tr, old):
-                    tr32 = tr.astype(jnp.float32)
-                    sums = jnp.tensordot(wk, tr32, axes=(0, 0))  # [K, ...]
-                    mean = sums / jnp.maximum(denom, 1e-9).reshape(
-                        (k_clusters,) + (1,) * (tr.ndim - 1)
-                    )
-                    keep_old = (denom <= 0).reshape(
-                        (k_clusters,) + (1,) * (tr.ndim - 1)
-                    )
-                    return jnp.where(
-                        keep_old, old.astype(jnp.float32), mean
-                    ).astype(old.dtype)
-
-                new_clusters = jax.tree_util.tree_map(
-                    combine, trained, cluster_params
-                )
-                return new_clusters, assign, closs
-
-            self._jit_cache[n_epochs] = jax.jit(round_fn)
+            self._jit_cache[n_epochs] = jax.jit(
+                self._assign_train_combine(n_epochs)
+            )
         return self._jit_cache[n_epochs]
+
+    def _round_fn_sharded(self, n_epochs: int):
+        key = ("sharded", n_epochs)
+        if key not in self._jit_cache:
+            from jax.sharding import PartitionSpec as P
+
+            from baton_tpu.parallel.mesh import CLIENT_AXIS
+
+            self._jit_cache[key] = jax.jit(jax.shard_map(
+                self._assign_train_combine(n_epochs,
+                                           psum_axis=CLIENT_AXIS),
+                mesh=self.sim.mesh,
+                in_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
+                          P(CLIENT_AXIS)),
+                out_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS)),
+                check_vma=False,
+            ))
+        return self._jit_cache[key]
 
     def run_round(
         self,
@@ -164,9 +209,26 @@ class ClusteredFedSim:
         n_samples = jnp.asarray(n_samples)
         c = int(n_samples.shape[0])
         rngs = jax.random.split(rng, c)
-        new_clusters, assign, closs = self._round_fn(n_epochs)(
-            cluster_params, data, n_samples, rngs
-        )
+        if self.sim.mesh is not None:
+            from baton_tpu.parallel.mesh import (
+                CLIENT_AXIS,
+                shard_client_arrays,
+            )
+
+            n_dev = int(self.sim.mesh.shape[CLIENT_AXIS])
+            target = -(-c // n_dev) * n_dev
+            data_p, n_p, rngs_p = self.sim._pad_wave(
+                data, n_samples, rngs, target
+            )
+            put = lambda t: shard_client_arrays(t, self.sim.mesh)
+            new_clusters, assign, closs = self._round_fn_sharded(n_epochs)(
+                cluster_params, put(data_p), put(n_p), put(rngs_p)
+            )
+            assign, closs = assign[:c], closs[:c]
+        else:
+            new_clusters, assign, closs = self._round_fn(n_epochs)(
+                cluster_params, data, n_samples, rngs
+            )
         w = n_samples.astype(jnp.float32)
         return ClusteredRoundResult(
             cluster_params=new_clusters,

@@ -92,6 +92,13 @@ class FedPer:
                     "axis; the hybrid clients x model mesh is not "
                     "supported here"
                 )
+            from baton_tpu.parallel.mesh import CLIENT_AXIS as _CA
+
+            if _CA not in sim.mesh.axis_names:
+                raise ValueError(
+                    f"mesh has axes {sim.mesh.axis_names} but sharded "
+                    f"rounds need a {_CA!r} axis"
+                )
             if sim.aggregator[0] != "mean":
                 raise ValueError(
                     "sharded FedPer aggregates shared leaves with a "
