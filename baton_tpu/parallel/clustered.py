@@ -70,20 +70,9 @@ class ClusteredFedSim:
                 "sims are not supported"
             )
         if sim.mesh is not None:
-            from baton_tpu.parallel.mesh import CLIENT_AXIS
-            from baton_tpu.parallel.tensor_parallel import MODEL_AXIS
+            from baton_tpu.parallel.mesh import require_clients_mesh
 
-            if MODEL_AXIS in sim.mesh.axis_names:
-                raise ValueError(
-                    "ClusteredFedSim shards clients over the clients "
-                    "axis; the hybrid clients x model mesh is not "
-                    "supported here"
-                )
-            if CLIENT_AXIS not in sim.mesh.axis_names:
-                raise ValueError(
-                    f"mesh has axes {sim.mesh.axis_names} but sharded "
-                    f"clustering needs a {CLIENT_AXIS!r} axis"
-                )
+            require_clients_mesh(sim.mesh, sim.aggregator, "ClusteredFedSim")
         if sim.aggregator[0] != "mean":
             raise ValueError(
                 "per-cluster aggregation is the sample-weighted mean; "
@@ -215,8 +204,10 @@ class ClusteredFedSim:
                 shard_client_arrays,
             )
 
+            from baton_tpu.ops.padding import round_up
+
             n_dev = int(self.sim.mesh.shape[CLIENT_AXIS])
-            target = -(-c // n_dev) * n_dev
+            target = round_up(c, n_dev)
             data_p, n_p, rngs_p = self.sim._pad_wave(
                 data, n_samples, rngs, target
             )

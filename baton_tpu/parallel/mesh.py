@@ -61,3 +61,28 @@ def shard_client_arrays(tree, mesh: Mesh, axis: str = CLIENT_AXIS):
     """Device-put a ``[C, ...]`` pytree sharded along the client axis."""
     sharding = client_sharding(mesh, axis)
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)
+
+
+def require_clients_mesh(mesh: Mesh, aggregator_spec, who: str) -> None:
+    """Shared construction-time contract for the client-axis wrappers
+    (FedPer / StatefulClients / ClusteredFedSim): a clients-only mesh,
+    no hybrid model axis, and the mean combine rule (the sharded kernels
+    aggregate with psum means; robust order statistics need the full
+    stack on one device)."""
+    from baton_tpu.parallel.tensor_parallel import MODEL_AXIS
+
+    if MODEL_AXIS in mesh.axis_names:
+        raise ValueError(
+            f"{who} shards client state over the {CLIENT_AXIS!r} axis; "
+            "the hybrid clients x model mesh is not supported here"
+        )
+    if CLIENT_AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has axes {mesh.axis_names} but {who} needs a "
+            f"{CLIENT_AXIS!r} axis"
+        )
+    if aggregator_spec[0] != "mean":
+        raise ValueError(
+            f"sharded {who} aggregates with a psum mean; robust rules "
+            "need the full stack on one device — use a meshless FedSim"
+        )

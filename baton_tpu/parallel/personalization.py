@@ -84,28 +84,9 @@ class FedPer:
                 "the FedSim without one for personalized rounds"
             )
         if sim.mesh is not None:
-            from baton_tpu.parallel.tensor_parallel import MODEL_AXIS
+            from baton_tpu.parallel.mesh import require_clients_mesh
 
-            if MODEL_AXIS in sim.mesh.axis_names:
-                raise ValueError(
-                    "FedPer shards the personal stack over the clients "
-                    "axis; the hybrid clients x model mesh is not "
-                    "supported here"
-                )
-            from baton_tpu.parallel.mesh import CLIENT_AXIS as _CA
-
-            if _CA not in sim.mesh.axis_names:
-                raise ValueError(
-                    f"mesh has axes {sim.mesh.axis_names} but sharded "
-                    f"rounds need a {_CA!r} axis"
-                )
-            if sim.aggregator[0] != "mean":
-                raise ValueError(
-                    "sharded FedPer aggregates shared leaves with a "
-                    "psum mean; robust rules need the full stack on one "
-                    "device — use a meshless FedSim for robust "
-                    "personalized rounds"
-                )
+            require_clients_mesh(sim.mesh, sim.aggregator, "FedPer")
         self.sim = sim
         self.personal_pred = personal
         self.partition = None
@@ -231,8 +212,10 @@ class FedPer:
                 shard_client_arrays,
             )
 
+            from baton_tpu.ops.padding import round_up
+
             n_dev = int(self.sim.mesh.shape[CLIENT_AXIS])
-            target = -(-c // n_dev) * n_dev
+            target = round_up(c, n_dev)
             # auto-pad with zero-weight phantoms like the engine's wave
             # path (_pad_wave): phantoms train on all-masked data, carry
             # FedAvg weight 0, and are excluded from the warm-start mean

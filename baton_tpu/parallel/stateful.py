@@ -64,28 +64,9 @@ class StatefulClients:
                 "pytree directly"
             )
         if sim.mesh is not None:
-            from baton_tpu.parallel.tensor_parallel import MODEL_AXIS
+            from baton_tpu.parallel.mesh import require_clients_mesh
 
-            if MODEL_AXIS in sim.mesh.axis_names:
-                raise ValueError(
-                    "StatefulClients shards the optimizer-state stack "
-                    "over the clients axis; the hybrid clients x model "
-                    "mesh is not supported here"
-                )
-            from baton_tpu.parallel.mesh import CLIENT_AXIS as _CA
-
-            if _CA not in sim.mesh.axis_names:
-                raise ValueError(
-                    f"mesh has axes {sim.mesh.axis_names} but sharded "
-                    f"rounds need a {_CA!r} axis"
-                )
-            if sim.aggregator[0] != "mean":
-                raise ValueError(
-                    "sharded StatefulClients aggregates with a psum "
-                    "mean; robust rules need the full stack on one "
-                    "device — use a meshless FedSim for robust stateful "
-                    "rounds"
-                )
+            require_clients_mesh(sim.mesh, sim.aggregator, "StatefulClients")
         self.sim = sim
         self._jit_cache: Dict[int, Any] = {}
 
@@ -179,8 +160,10 @@ class StatefulClients:
             )
             from baton_tpu.parallel.personalization import _pad_stack
 
+            from baton_tpu.ops.padding import round_up
+
             n_dev = int(self.sim.mesh.shape[CLIENT_AXIS])
-            target = -(-c // n_dev) * n_dev
+            target = round_up(c, n_dev)
             # auto-pad with zero-weight phantoms like the engine's wave
             # path; phantom optimizer states are row-0 copies that the
             # all-masked training leaves untouched
