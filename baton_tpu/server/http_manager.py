@@ -324,6 +324,10 @@ class Experiment:
             # validate at the door: a missing/mis-shaped tensor must be
             # rejected now, not crash aggregation after the round state
             # is consumed (which would discard every client's work)
+            # coerce meta fields HERE so a malformed n_samples/
+            # loss_history 400s at the door instead of 500ing later
+            meta_n_samples = float(meta.get("n_samples", 0))
+            meta_losses = [float(x) for x in meta.get("loss_history", [])]
             compressed_anchor = None
             if meta.get("compressed"):
                 if self.secure_agg:
@@ -342,9 +346,9 @@ class Experiment:
                         {"err": f"Unknown Compression Scheme {scheme!r}"},
                         status=400,
                     )
-                # one device-to-host materialization per upload, shared
-                # by validation and reconstruction below; under a
-                # quantized broadcast the anchor is what clients LOADED
+                # the per-round anchor (set once in start_round; what
+                # clients loaded). Fallback covers uploads arriving for
+                # a round started before a manager code reload.
                 compressed_anchor = (
                     self._broadcast_anchor_sd
                     if self._broadcast_anchor_sd is not None
@@ -397,8 +401,8 @@ class Experiment:
             {
                 "state_dict": tensors,
                 "masked": bool(meta.get("secure", False)),
-                "n_samples": float(meta.get("n_samples", 0)),
-                "loss_history": [float(x) for x in meta.get("loss_history", [])],
+                "n_samples": meta_n_samples,
+                "loss_history": meta_losses,
             },
         )
         self.registry.record_update(client_id, round_name)
@@ -427,12 +431,18 @@ class Experiment:
                 # duplicate indices silently drop delta mass in the
                 # scatter (dense[idx] = val keeps only the last write)
                 raise ValueError(f"duplicate indices for {k}")
+            if not np.all(np.isfinite(np.asarray(val, np.float64))):
+                raise ValueError(f"non-finite values for {k}")
             if f"{k}@scale" in tensors:
                 scale = np.asarray(tensors[f"{k}@scale"]).ravel()
                 if scale.size != 1 or not np.isfinite(scale[0]):
                     raise ValueError(f"bad scale for {k}")
-            if not np.all(np.isfinite(np.asarray(val, np.float64))):
-                raise ValueError(f"non-finite values for {k}")
+                # a finite-but-huge scale can overflow val*scale to inf
+                # in float32 and poison the aggregate past this door
+                if val.size and not np.all(np.isfinite(
+                    np.asarray(val, np.float32) * np.float32(scale[0])
+                )):
+                    raise ValueError(f"scale overflow for {k}")
 
     def _decompress_upload(self, tensors, anchor) -> dict:
         """anchor + sparse delta -> dense state_dict (float32)."""
@@ -501,7 +511,12 @@ class Experiment:
                 )
             )
         else:
-            self._broadcast_anchor_sd = None
+            # materialize the round anchor ONCE here, not per upload:
+            # self.params is invariant until end_round, and a per-upload
+            # params_to_state_dict is a full-model device-to-host copy
+            self._broadcast_anchor_sd = {
+                k: np.asarray(v) for k, v in state_dict.items()
+            }
         cohort_ids = self._sample_cohort()
         if self.secure_agg:
             # Bonawitz round 0 (AdvertiseKeys): per-round DH key

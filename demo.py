@@ -129,6 +129,8 @@ def main() -> None:
             data = linear_client_data(nprng)
             return data, data["x"].shape[0]
 
+        import secrets as _secrets
+
         worker = ExperimentWorker(
             app,
             model,
@@ -137,6 +139,10 @@ def main() -> None:
             trainer=make_local_trainer(model, batch_size=32, learning_rate=0.001),
             get_data=get_data,
             compress=args.compress,
+            # unique per process: quantizer rounding noise must be
+            # independent across workers or the cohort mean's error
+            # stops shrinking with N (ops/compression.py seed note)
+            rng_seed=_secrets.randbits(31),
         )
         # per-epoch progress at GET /{name}/metrics (user-supplied
         # trainers don't get the hook automatically; one worker per

@@ -118,3 +118,11 @@ def test_advanced_aggregation():
     assert out["fedbuff_err"] < 1.5
     assert out["personalized_acc"] > out["global_acc"]
     assert out["clusters_separated"] and out["clustered_loss"] < 1.0
+
+
+def test_bandwidth_efficient_http():
+    m = _load("09_bandwidth_efficient_http")
+    out = m.run(n_workers=3, n_rounds=8)
+    assert out["accuracy"] > 0.8
+    # sparse q16 uploads are a small fraction of the full state dict
+    assert out["mean_upload_bytes"] < out["full_upload_bytes"] / 2
