@@ -8,6 +8,7 @@ from baton_tpu.parallel.ring_attention import (
     ring_attention,
     ulysses_attention,
     make_ring_attention_fn,
+    make_striped_attention_fn,
     make_ulysses_attention_fn,
 )
 from baton_tpu.parallel.multihost import initialize_multihost, make_hybrid_mesh
@@ -34,6 +35,7 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "make_ring_attention_fn",
+    "make_striped_attention_fn",
     "make_ulysses_attention_fn",
     "initialize_multihost",
     "make_hybrid_mesh",

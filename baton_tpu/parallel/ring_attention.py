@@ -79,7 +79,7 @@ def _block_pv(p, v, hq):
 
 
 def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
-                   bias=None):
+                   bias=None, striped: bool = False):
     """Exact attention with K/V ring-rotated over ``axis_name``.
 
     Call inside ``shard_map`` with q, k, v sharded on the length axis
@@ -92,6 +92,17 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
     mask padding keys) — it is sharded exactly like K/V and rides the
     same ring rotations, so global key positions keep their bias no
     matter which device currently holds the block.
+
+    ``striped=True`` switches the position mapping to the striped
+    (round-robin) layout: device ``d``'s local index ``j`` is global
+    token ``j*N + d``. Contiguous causal sharding is load-IMBALANCED —
+    device 0's queries see one block, device N-1's see all N, so
+    wall-clock is the worst device and the causal skip saves energy but
+    not time. Striping gives every (query-shard, key-block) pair ~half
+    a block of unmasked work, so all devices finish together (the
+    "striped attention" layout). Use
+    :func:`make_striped_attention_fn`, which handles the token
+    permutation at the seam.
     """
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
@@ -128,8 +139,13 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
             scores = _block_scores(qf, k_cur.astype(jnp.float32), scale)
             scores = scores + b_cur[:, None, None, :]
             if causal:
-                q_pos = my * lc + jnp.arange(lc)
-                k_pos = src * lc + jnp.arange(lk)
+                if striped:
+                    # striped layout: local j on shard d = token j*n + d
+                    q_pos = my + n * jnp.arange(lc)
+                    k_pos = src + n * jnp.arange(lk)
+                else:
+                    q_pos = my * lc + jnp.arange(lc)
+                    k_pos = src * lc + jnp.arange(lk)
                 scores = jnp.where(
                     q_pos[:, None] >= k_pos[None, :], scores, _NEG
                 )
@@ -144,9 +160,13 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
             )
             return o_new, m_new, l_new
 
-        if causal:
-            # a block strictly in this shard's future is fully masked:
-            # skip its two matmuls (≈halves causal ring FLOPs on average)
+        if causal and not striped:
+            # contiguous layout: a block strictly in this shard's future
+            # is fully masked — skip its two matmuls (≈halves causal ring
+            # FLOPs on average, but the savings land unevenly: device 0
+            # skips almost everything, device n-1 nothing). The striped
+            # layout has no fully-masked pairs to skip; its win is that
+            # every pair carries the SAME ~half-block of work.
             return lax.cond(src <= my, attend, lambda c: c, (o, m, l))
         return attend((o, m, l))
 
@@ -436,6 +456,60 @@ def make_ring_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
         if bias is None:
             return fn(q, k, v)
         return fn(q, k, v, _check_seam_bias(bias, q.shape[0], k.shape[2]))
+
+    return attention_fn
+
+
+def make_striped_attention_fn(mesh: Mesh, axis_name: str = SEQ_AXIS):
+    """An ``attention_fn`` running CAUSAL ring attention in the striped
+    (round-robin) token layout — the load-balanced form of causal
+    sequence parallelism.
+
+    Why: under the contiguous layout, causality makes the ring
+    imbalanced — the shard holding the sequence tail attends every
+    rotated block while the head shard attends one, so the step time is
+    the tail shard's and the causal skip saves no wall-clock. Striping
+    assigns token ``t`` to device ``t % N``: every (shard, rotated
+    block) pair then carries the same ~half block of unmasked work and
+    all devices finish each ring step together.
+
+    The permutation in/out of striped order happens here at the seam
+    (one gather each way around the attention stack); positions inside
+    the kernel are mapped accordingly, so the result equals dense causal
+    attention exactly. Non-causal calls fall back to the plain ring
+    (striping buys nothing without a triangular mask).
+    """
+
+    plain_ring = make_ring_attention_fn(mesh, axis_name)
+
+    def attention_fn(q, k, v, bias=None, causal=False):
+        n = mesh.shape[axis_name]
+        l = q.shape[2]
+        if l % n:
+            raise ValueError(
+                f"striped attention needs sequence length divisible by "
+                f"mesh axis {axis_name!r} size {n}; got L={l}"
+            )
+        if not causal:
+            # striping buys nothing without a triangular mask — delegate
+            # to the one ring seam instead of duplicating it
+            return plain_ring(q, k, v, bias=bias, causal=False)
+
+        # stripe: token j*n + d -> contiguous slot (d, j), so the
+        # contiguous shard_map spec hands device d exactly its stripe
+        perm = jnp.arange(l).reshape(l // n, n).T.reshape(l)
+        inv = jnp.argsort(perm)
+        qs, ks, vs = (x[:, :, perm, :] for x in (q, k, v))
+        kernel = partial(ring_attention, axis_name=axis_name, causal=True,
+                         striped=True)
+        fn = _seq_sharded_fn(kernel, mesh, axis_name,
+                             with_bias=bias is not None)
+        if bias is None:
+            out = fn(qs, ks, vs)
+        else:
+            b2 = _check_seam_bias(bias, q.shape[0], k.shape[2])
+            out = fn(qs, ks, vs, b2[:, perm])
+        return out[:, :, inv, :]
 
     return attention_fn
 
