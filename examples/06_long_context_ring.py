@@ -28,20 +28,33 @@ from baton_tpu.parallel.mesh import make_mesh
 from baton_tpu.parallel.ring_attention import (
     make_flash_ring_attention_fn,
     make_ring_attention_fn,
+    make_striped_attention_fn,
 )
 
 
 def run(n_devices=8, seq_len=64, n_steps=3, batch_size=2, lr=1e-2,
-        config=None, remat=False, flash=True, seed=0):
+        config=None, remat=False, flash=True, striped=False, seed=0):
+    """``striped=True`` uses the load-balanced causal layout
+    (round-robin token sharding) instead of the contiguous ring — same
+    exact math, but every shard does equal work per ring step instead of
+    the tail shard gating it (parallel/ring_attention.py). NOTE: the
+    striped path runs the DENSE ring kernel (there is no striped flash
+    variant yet), so per-shard attention memory is O((L/N)^2) — size the
+    sequence accordingly; ``flash`` is ignored when ``striped`` is
+    set."""
     mesh = make_mesh(n_devices=n_devices, axis_names=("seq",))
     cfg = config or LlamaConfig.tiny(
         max_len=seq_len, n_heads=4, n_kv_heads=2, n_layers=2
     )
-    attn = (
-        make_flash_ring_attention_fn(mesh)
-        if flash
-        else make_ring_attention_fn(mesh)
-    )
+    if striped:
+        if flash:
+            print("note: striped layout uses the dense ring kernel "
+                  "(no striped flash variant); flash ignored")
+        attn = make_striped_attention_fn(mesh)
+    elif flash:
+        attn = make_flash_ring_attention_fn(mesh)
+    else:
+        attn = make_ring_attention_fn(mesh)
     model = llama_lm_model(cfg, attention_fn=attn, remat=remat)
     trainer = make_local_trainer(model, batch_size=batch_size,
                                  learning_rate=lr)
@@ -69,15 +82,19 @@ def run(n_devices=8, seq_len=64, n_steps=3, batch_size=2, lr=1e-2,
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--scale", choices=["tiny", "full"], default="tiny")
+    p.add_argument("--striped", action="store_true",
+                   help="load-balanced causal layout (striped attention)")
     args = p.parse_args()
     if args.scale == "full":
-        # a real TPU slice: 32k tokens ring-sharded 8 ways, remat on,
-        # realistic vocab (the lm_head is the model's largest matmul)
-        run(n_devices=8, seq_len=32768, n_steps=5, batch_size=1,
-            config=LlamaConfig(vocab_size=32000, max_len=32768,
+        # a real TPU slice: ring x flash takes 32k tokens 8 ways; the
+        # striped (dense-kernel) variant is sized down to keep each
+        # shard's O((L/N)^2) score block in HBM
+        seq = 8192 if args.striped else 32768
+        run(n_devices=8, seq_len=seq, n_steps=5, batch_size=1,
+            config=LlamaConfig(vocab_size=32000, max_len=seq,
                                d_model=512, n_heads=8, n_kv_heads=4,
                                n_layers=8, d_ff=1536),
-            remat=True)
+            remat=True, striped=args.striped)
     else:
-        losses = run()
+        losses = run(striped=args.striped)
         assert losses[-1] < losses[0], "loss should fall"

@@ -126,3 +126,9 @@ def test_bandwidth_efficient_http():
     assert out["accuracy"] > 0.8
     # sparse q16 uploads are a small fraction of the full state dict
     assert out["mean_upload_bytes"] < out["full_upload_bytes"] / 2
+
+
+def test_long_context_striped():
+    m = _load("06_long_context_ring")
+    losses = m.run(n_steps=3, striped=True)
+    assert losses[-1] < losses[0]
