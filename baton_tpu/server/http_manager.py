@@ -987,6 +987,11 @@ class Experiment:
                 corrections.append(
                     secure.dropout_correction(d, seeds, template)
                 )
+                # the reconstructed key exists only to cancel this
+                # round's residues — purge its cached DH powers so the
+                # dropped client's pairwise secrets don't outlive the
+                # finalization (secure.py forward-secrecy contract)
+                secure.purge_dh_secrets(c_sk)
             self_seeds = []
             for s_cid in survivors:
                 b_int = secure.shamir_reconstruct(

@@ -247,7 +247,10 @@ class ExperimentWorker:
             "peer_shares": {}, "partition": None,
         }
         while len(self._secure) > 2:  # keep current + previous round
-            self._secure.pop(next(iter(self._secure)))
+            old = self._secure.pop(next(iter(self._secure)))
+            # forward secrecy: evicting a round's keys must also drop
+            # the cached DH powers derived from them (secure.py)
+            secure.purge_dh_secrets(old["c_sk"], old["s_sk"])
         return web.json_response({"c_pk": f"{c_pk:x}", "s_pk": f"{s_pk:x}"})
 
     async def handle_secure_shares(self, request: web.Request) -> web.Response:

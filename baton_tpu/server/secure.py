@@ -115,6 +115,41 @@ def dh_keypair() -> Tuple[int, int]:
     return sk, pow(MODP_G, sk, MODP_P)
 
 
+# Cached DH powers. The 2048-bit modexp is the protocol's dominant host
+# cost (measured ~7 ms each; a 64-cohort round needs ~190 per client)
+# and depends only on (sk, pk), not the context — so the three
+# context-distinct derivations per peer pair (mask seed + one sealed-box
+# direction each way) share one cached power. Forward secrecy is why the
+# keypairs are per-round, so the cache must not outlive them: parties
+# call :func:`purge_dh_secrets` when they discard a round's secure state
+# (worker key rotation, manager round finalization/abort) — a plain dict
+# with targeted eviction, NOT an lru_cache that would retain old rounds'
+# shared secrets for the process lifetime.
+_DH_CACHE: Dict[Tuple[int, int], bytes] = {}
+_DH_CACHE_MAX = 16384
+
+
+def _dh_raw(sk: int, pk_other: int) -> bytes:
+    key = (sk, pk_other)
+    v = _DH_CACHE.get(key)
+    if v is None:
+        v = pow(pk_other, sk, MODP_P).to_bytes(256, "big")
+        if len(_DH_CACHE) >= _DH_CACHE_MAX:
+            _DH_CACHE.clear()  # hard bound; entries are round-scoped
+        _DH_CACHE[key] = v
+    return v
+
+
+def purge_dh_secrets(*sks: int) -> None:
+    """Drop every cached DH power derived from the given secret keys.
+    Call when a round's secure state is discarded — after this, only a
+    party still holding the ephemeral sk itself can rederive the pairwise
+    seeds (the forward-secrecy contract of per-round keypairs)."""
+    dead = [k for k in _DH_CACHE if k[0] in sks]
+    for k in dead:
+        del _DH_CACHE[k]
+
+
 def dh_shared_seed(sk: int, pk_other: int, context: str) -> bytes:
     """32-byte pairwise seed: SHA-256(context ‖ g^(sk_i·sk_j) mod p).
 
@@ -123,9 +158,8 @@ def dh_shared_seed(sk: int, pk_other: int, context: str) -> bytes:
     """
     if not 1 < pk_other < MODP_P - 1:
         raise ValueError("invalid DH public key")
-    shared = pow(pk_other, sk, MODP_P)
     return hashlib.sha256(
-        context.encode() + b"|" + shared.to_bytes(256, "big")
+        context.encode() + b"|" + _dh_raw(sk, pk_other)
     ).digest()
 
 

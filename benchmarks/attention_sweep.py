@@ -2,11 +2,22 @@
 default_attention dispatch policy (models/transformer.py) and the
 flash kernel's default block sizes (ops/flash_attention.py).
 
-Usage:  python benchmarks/attention_sweep.py [--lens 2048,4096] \
-            [--blocks 256x256,512x512,512x1024]
+Writes benchmarks/attention_sweep_tpu.json (the committed artifact the
+dispatch threshold cites) in addition to the human-readable table.
+
+Usage:  python benchmarks/attention_sweep.py [--lens 1024,2048,4096,8192] \
+            [--blocks 256x256,512x512,512x1024] [--dense-max 4096]
+
+``--dense-max`` caps the lengths at which the DENSE kernel is timed: its
+[B, H, L, L] fp32 score tensor is 8.6 GB at L=8192 (B=4, H=8) and a
+backward pass would OOM a 16 GB chip — and a deliberate OOM puts the
+tunneled TPU into a multi-hour recovery (TPU_EVIDENCE_r3.md), so the
+sweep never attempts it.
 """
 
 import argparse
+import json
+import os
 import time
 
 import jax
@@ -39,13 +50,28 @@ def timeit(fn, L, b=4, h=8, d=64, iters=10):
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--lens", default="2048,4096")
-    p.add_argument("--blocks", default="128x128,256x256,512x512,512x1024")
+    p.add_argument("--lens", default="1024,2048,4096,8192")
+    p.add_argument("--blocks",
+                   default="128x128,128x256,256x256,256x512,512x512,"
+                           "512x1024,1024x1024")
+    p.add_argument("--dense-max", type=int, default=4096)
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "attention_sweep_tpu.json"))
     args = p.parse_args()
+    dev = jax.devices()[0]
     print(f"backend: {jax.default_backend()}")
+    results = []
     for L in (int(x) for x in args.lens.split(",")):
-        d = timeit(dot_product_attention, L)
-        print(f"L={L} dense fwd+bwd {d:.2f} ms")
+        rec = {"L": L, "flash": {}}
+        if L <= args.dense_max:
+            d = timeit(dot_product_attention, L)
+            rec["dense_ms"] = round(d, 2)
+            print(f"L={L} dense fwd+bwd {d:.2f} ms")
+        else:
+            d = None
+            print(f"L={L} dense skipped (scores tensor would OOM; "
+                  f"--dense-max {args.dense_max})")
         for spec in args.blocks.split(","):
             bq, bk = (int(x) for x in spec.split("x"))
             if bq > L or bk > L:
@@ -56,7 +82,21 @@ def main():
                 ),
                 L,
             )
-            print(f"  flash bq={bq} bk={bk}: {f:.2f} ms ({d / f:.2f}x)")
+            rec["flash"][spec] = round(f, 2)
+            ratio = f" ({d / f:.2f}x)" if d else ""
+            print(f"  flash bq={bq} bk={bk}: {f:.2f} ms{ratio}")
+        results.append(rec)
+        # write after every length: a mid-sweep tunnel death keeps the
+        # lengths already measured
+        with open(args.out, "w") as f:
+            json.dump({
+                "platform": dev.platform,
+                "device_kind": getattr(dev, "device_kind", dev.platform),
+                "shape": {"batch": 4, "heads": 8, "head_dim": 64,
+                          "dtype": "bfloat16", "causal": True,
+                          "measure": "fwd+bwd(q,k,v), mean of 10"},
+                "results": results,
+            }, f, indent=2)
 
 
 if __name__ == "__main__":

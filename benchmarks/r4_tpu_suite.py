@@ -1,0 +1,471 @@
+"""Round-4 hardware measurement suite — runs every TPU measurement the
+round needs, in judge-priority order, the moment the tunnel answers.
+
+Stages (each an isolated child subprocess with its own timeout, so one
+hang/crash cannot take out the rest; results append to
+``benchmarks/r4_tpu_results.jsonl`` as they land):
+
+1. ``headline``      — bench.py itself (ResNet-18 bf16, 32 clients):
+                       rounds/s + mfu + peak_hbm_gb (VERDICT r3 items 1, 3)
+2. ``conv``          — per-client-conv lowering shootout: vmap-direct
+                       (grouped conv) vs vmap-im2col (batched matmul) vs
+                       stacked batch_group_count, layer micro + full
+                       round (VERDICT item 2a)
+3. ``headline_im2col`` — bench.py with BATON_BENCH_CONV_IMPL=im2col (the
+                       candidate MFU fix measured end-to-end)
+4. ``bert``          — transformer flagship MFU: BERT-base federated
+                       round, FLOPs from XLA cost analysis (item 2b;
+                       target measured mfu >= 0.2)
+5. ``wave1024``      — the north-star cohort: 1024 clients in waves of
+                       {32, 64}, rounds/s + per-wave peak HBM (item 4)
+6. ``attn``          — attention_sweep.py, L in {1024..8192} x blocks,
+                       dense capped at 4096 to avoid the OOM that killed
+                       the r3 tunnel (item 7)
+
+Never deliberately OOMs the chip (TPU_EVIDENCE_r3.md "The outage").
+
+Usage:
+    python benchmarks/r4_tpu_suite.py                 # all stages
+    python benchmarks/r4_tpu_suite.py --stages conv   # subset
+    python benchmarks/r4_tpu_suite.py --child conv    # (internal)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_JSONL = os.path.join(REPO, "benchmarks", "r4_tpu_results.jsonl")
+
+V5E_PEAK_BF16 = 197e12
+# ResNet-18 CIFAR fwd FLOPs/image (bench.py); train ~ 3x fwd
+RESNET_TRAIN_FLOPS_PER_IMG = 3.0 * 1.11e9
+
+# BATON_SUITE_SMOKE=1 shrinks every stage to CPU-compilable sizes so the
+# suite's plumbing (children, JSONL, parsing) is testable without the
+# chip; numbers from a smoke run are meaningless and labelled as such.
+SMOKE = os.environ.get("BATON_SUITE_SMOKE") == "1"
+
+
+def _jax_setup():
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                       "/tmp/baton_tpu_jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax
+
+
+def _peak_hbm_gb(dev):
+    try:
+        stats = dev.memory_stats() or {}
+        return round(stats.get("peak_bytes_in_use", 0) / 2**30, 3)
+    except Exception:
+        return None
+
+
+def _cost_flops(jitted, *args):
+    """XLA's own FLOP count for one dispatch of ``jitted`` — the
+    'measured, not analytic' MFU numerator. None when the backend
+    doesn't surface cost analysis."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, list):  # older jax returns [dict]
+            ca = ca[0]
+        f = ca.get("flops")
+        return float(f) if f and f > 0 else None
+    except Exception:
+        return None
+
+
+# ======================================================================
+# stage: conv — the grouped-conv shootout
+def child_conv() -> dict:
+    jax = _jax_setup()
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    C, B = (2, 4) if SMOKE else (32, 32)
+    out = {"stage": "conv", "platform": dev.platform,
+           "device_kind": getattr(dev, "device_kind", dev.platform),
+           "clients": C, "batch": B, "layers": [], "full_model": {}}
+
+    from baton_tpu.models.resnet import _conv_direct, _conv_im2col
+
+    def conv_bgc(xs, ws, stride):
+        """Per-client conv via batch_group_count: lhs [C*B,H,W,cin],
+        rhs [kh,kw,cin,C*cout], G=C — XLA's weight-gradient lowering
+        path, the formulation VERDICT r3 item 2a asks to try."""
+        c, b, h, w, cin = xs.shape
+        kh, kw, _, cout = ws.shape[1:5] if ws.ndim == 5 else ws.shape
+        lhs = xs.reshape(c * b, h, w, cin)
+        rhs = jnp.moveaxis(ws, 0, 3).reshape(kh, kw, cin, c * cout)
+        o = jax.lax.conv_general_dilated(
+            lhs, rhs.astype(lhs.dtype), (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            batch_group_count=c,
+        )
+        oh, ow = o.shape[1:3]
+        return jnp.moveaxis(o.reshape(b, oh, ow, c, cout), 3, 0)
+
+    def time_fn(f, *args, iters=20):
+        jax.block_until_ready(f(*args))  # compile
+        t = time.perf_counter()
+        for _ in range(iters):
+            o = f(*args)
+        jax.block_until_ready(o)
+        return (time.perf_counter() - t) / iters
+
+    # --- layer microbench: fwd+bwd of sum(conv(x, w)) per strategy ---
+    layer_shapes = ([(8, 8, 8, 1)] if SMOKE else
+                    [(64, 64, 32, 1), (128, 128, 16, 1),
+                     (256, 256, 8, 1), (64, 128, 32, 2)])
+    for cin, cout, hw, stride in layer_shapes:
+        kx, kw_ = jax.random.split(jax.random.key(cin + hw))
+        xs = jax.random.normal(kx, (C, B, hw, hw, cin), jnp.bfloat16)
+        ws = jax.random.normal(kw_, (C, 3, 3, cin, cout), jnp.bfloat16)
+        oh = -(-hw // stride)
+        flops = 2 * C * B * oh * oh * 9 * cin * cout * 3  # fwd+bwd~3x
+
+        rec = {"cin": cin, "cout": cout, "hw": hw, "stride": stride}
+        strategies = {
+            "vmap_direct": jax.vmap(
+                lambda x, w: _conv_direct(x, w, stride)),
+            "vmap_im2col": jax.vmap(
+                lambda x, w: _conv_im2col(x, w, stride)),
+            "batch_group_count": lambda xs, ws: conv_bgc(xs, ws, stride),
+        }
+        for name, fn in strategies.items():
+            try:
+                g = jax.jit(jax.grad(
+                    lambda a, b2: jnp.sum(fn(a, b2).astype(jnp.float32)),
+                    argnums=(0, 1)))
+                dt = time_fn(lambda a, b2: g(a, b2), xs, ws)
+                rec[name] = {"ms": round(dt * 1e3, 3),
+                             "mfu": round(flops / dt / V5E_PEAK_BF16, 4)}
+            except Exception as e:
+                rec[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        out["layers"].append(rec)
+
+    # --- full federated round: direct vs im2col ResNet-18 ---
+    from baton_tpu.models.resnet import resnet18_cifar_model
+    from baton_tpu.ops.padding import stack_client_datasets
+    from baton_tpu.parallel.engine import FedSim
+
+    rng = np.random.default_rng(0)
+    img, spc = (8, 8) if SMOKE else (32, 48)
+    datasets = [{
+        "x": rng.normal(size=(spc, img, img, 3)).astype(np.float32),
+        "y": rng.integers(0, 10, size=(spc,)).astype(np.int32),
+    } for _ in range(C)]
+    data, n_samples = stack_client_datasets(datasets, batch_size=spc)
+    data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+    key = jax.random.key(1)
+
+    from baton_tpu.models.resnet import resnet_model
+    for impl in ("direct", "im2col"):
+        model = (resnet_model(blocks_per_stage=(1,), n_groups=4,
+                              conv_impl=impl)
+                 if SMOKE else
+                 resnet18_cifar_model(compute_dtype=jnp.bfloat16,
+                                      conv_impl=impl))
+        params = model.init(jax.random.key(0))
+        sim = FedSim(model, batch_size=spc, learning_rate=0.05)
+        t_c = time.perf_counter()
+        res = sim.run_round(params, data, n_samples, key,
+                            collect_client_losses=False)
+        float(res.loss_history[-1])
+        compile_s = time.perf_counter() - t_c
+        iters, p = (2 if SMOKE else 12), res.params
+        t0 = time.perf_counter()
+        for i in range(iters):
+            res = sim.run_round(p, data, n_samples,
+                                jax.random.fold_in(key, i),
+                                collect_client_losses=False)
+            p = res.params
+        float(res.loss_history[-1])
+        dt = (time.perf_counter() - t0) / iters
+        sps = C * spc / dt
+        out["full_model"][impl] = {
+            "rounds_per_sec": round(1 / dt, 3),
+            "samples_per_sec_per_chip": round(sps, 1),
+            "mfu_analytic": round(
+                sps * RESNET_TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16, 4),
+            "compile_s": round(compile_s, 1),
+        }
+    out["peak_hbm_gb"] = _peak_hbm_gb(dev)
+    return out
+
+
+# ======================================================================
+# stage: bert — transformer flagship MFU
+def child_bert() -> dict:
+    jax = _jax_setup()
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    from baton_tpu.models.bert import BertConfig, bert_classifier_model
+    from baton_tpu.ops.padding import stack_client_datasets
+    from baton_tpu.parallel.engine import FedSim
+
+    # BERT-base: per-client matmuls lower to batched matmuls over the
+    # client axis — the MXU-friendly flagship (VERDICT r3 item 2b).
+    C, B, L = (2, 4, 16) if SMOKE else (8, 32, 128)
+    cfg = (BertConfig.tiny(max_len=L) if SMOKE else
+           BertConfig(vocab_size=30522, max_len=L, d_model=768,
+                      n_layers=12, n_heads=12, d_ff=3072, n_classes=4))
+    model = bert_classifier_model(cfg, compute_dtype=jnp.bfloat16,
+                                  name="bert_base_bf16")
+    params = model.init(jax.random.key(0))
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+
+    rng = np.random.default_rng(0)
+    datasets = [{
+        "x": rng.integers(0, cfg.vocab_size, size=(B, L)).astype(np.int32),
+        "y": rng.integers(0, 4, size=(B,)).astype(np.int32),
+    } for _ in range(C)]
+    data, n_samples = stack_client_datasets(datasets, batch_size=B)
+    data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    sim = FedSim(model, batch_size=B, learning_rate=0.01)
+    key = jax.random.key(1)
+
+    t_c = time.perf_counter()
+    res = sim.run_round(params, data, n_samples, key,
+                        collect_client_losses=False)
+    float(res.loss_history[-1])
+    compile_s = time.perf_counter() - t_c
+
+    iters, p = 10, res.params
+    t0 = time.perf_counter()
+    for i in range(iters):
+        res = sim.run_round(p, data, n_samples, jax.random.fold_in(key, i),
+                            collect_client_losses=False)
+        p = res.params
+    float(res.loss_history[-1])
+    dt = (time.perf_counter() - t0) / iters
+
+    # XLA's own FLOP count for the wave kernel — measured, not analytic
+    rngs = jax.random.split(key, C)
+    try:
+        jitted = jax.jit(
+            lambda pr, d, n, r: sim._wave_sums_raw(pr, None, d, n, r, 1))
+        xla_flops = _cost_flops(jitted, p, data, n_samples, rngs)
+    except Exception:
+        xla_flops = None
+
+    tokens_per_round = C * B * L
+    analytic_flops = 6.0 * n_params * tokens_per_round
+    flops = xla_flops or analytic_flops
+    sps = C * B / dt
+    return {
+        "stage": "bert", "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "model": "bert_base_bf16", "n_params": n_params,
+        "clients": C, "batch": B, "seq_len": L,
+        "rounds_per_sec": round(1 / dt, 3),
+        "samples_per_sec_per_chip": round(sps, 1),
+        "tokens_per_sec_per_chip": round(sps * L, 1),
+        "flops_per_round_xla": xla_flops,
+        "flops_per_round_analytic": analytic_flops,
+        "mfu": round(flops / dt / V5E_PEAK_BF16, 4),
+        "mfu_analytic": round(analytic_flops / dt / V5E_PEAK_BF16, 4),
+        "compile_s": round(compile_s, 1),
+        "peak_hbm_gb": _peak_hbm_gb(dev),
+    }
+
+
+# ======================================================================
+# stage: wave1024 — the north-star cohort on one chip
+def child_wave1024(wave_size: int, conv_impl: str = "direct") -> dict:
+    jax = _jax_setup()
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    from baton_tpu.models.resnet import resnet18_cifar_model
+    from baton_tpu.ops.padding import stack_client_datasets
+    from baton_tpu.parallel.engine import FedSim
+
+    C, S = (8, 4) if SMOKE else (1024, 48)
+    img = 8 if SMOKE else 32
+    rng = np.random.default_rng(0)
+    datasets = [{
+        "x": rng.normal(size=(S, img, img, 3)).astype(np.float32),
+        "y": rng.integers(0, 10, size=(S,)).astype(np.int32),
+    } for _ in range(C)]
+    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    if SMOKE:
+        from baton_tpu.models.resnet import resnet_model
+        model = resnet_model(blocks_per_stage=(1,), n_groups=4,
+                             conv_impl=conv_impl)
+        wave_size = min(wave_size, 4)
+    else:
+        model = resnet18_cifar_model(compute_dtype=jnp.bfloat16,
+                                     conv_impl=conv_impl)
+    params = model.init(jax.random.key(0))
+    # batch_size 32 matches bench.py's headline config (48-sample clients
+    # train one batch of 32 + one masked batch of 16)
+    sim = FedSim(model, batch_size=S if SMOKE else 32, learning_rate=0.05)
+    key = jax.random.key(1)
+
+    t_c = time.perf_counter()
+    res = sim.run_round(params, data, n_samples, key,
+                        wave_size=wave_size, collect_client_losses=False)
+    float(res.loss_history[-1])
+    compile_s = time.perf_counter() - t_c
+
+    iters, p = 3, res.params
+    t0 = time.perf_counter()
+    for i in range(iters):
+        res = sim.run_round(p, data, n_samples, jax.random.fold_in(key, i),
+                            wave_size=wave_size, collect_client_losses=False)
+        p = res.params
+    float(res.loss_history[-1])
+    dt = (time.perf_counter() - t0) / iters
+    sps = C * S / dt
+    return {
+        "stage": "wave1024", "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "model": f"resnet18_bf16_{conv_impl}", "clients": C,
+        "samples_per_client": S, "wave_size": wave_size,
+        "n_waves": -(-C // wave_size),
+        "rounds_per_sec": round(1 / dt, 4),
+        "seconds_per_round": round(dt, 2),
+        "samples_per_sec_per_chip": round(sps, 1),
+        "mfu_analytic": round(
+            sps * RESNET_TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16, 4),
+        "compile_s": round(compile_s, 1),
+        "peak_hbm_gb": _peak_hbm_gb(dev),
+        # the honest extrapolation: a v4-32 runs 32 of these shards in
+        # parallel (one 32-client shard each) + one psum round boundary
+        "v4_32_extrapolation_note": (
+            "1024 clients sharded 32/chip over a v4-32 mesh runs one "
+            "32-client wave per chip in parallel; this single-chip waved "
+            "number is the degenerate 1-chip layout"),
+    }
+
+
+# ======================================================================
+STAGES = ("headline", "conv", "headline_im2col", "bert", "wave1024", "attn")
+
+
+def append_result(rec: dict) -> None:
+    rec = dict(rec)
+    rec["t_wall"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    with open(OUT_JSONL, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def run_child(args, timeout_s, tag, extra_env=None,
+              artifact: str | None = None) -> None:
+    """``artifact``: for children whose stdout is a human-readable table
+    (attention_sweep.py), don't parse stdout — success means the named
+    artifact file was their real output."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    t0 = time.perf_counter()
+    print(f"[suite] {tag}: starting (timeout {timeout_s:.0f}s)",
+          file=sys.stderr, flush=True)
+    try:
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              timeout=timeout_s, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        append_result({"stage": tag, "failed": "timeout",
+                       "timeout_s": timeout_s})
+        print(f"[suite] {tag}: TIMEOUT", file=sys.stderr, flush=True)
+        return
+    wall = round(time.perf_counter() - t0, 1)
+    if proc.returncode != 0:
+        append_result({"stage": tag, "failed": f"rc={proc.returncode}",
+                       "stderr_tail": proc.stderr.strip()[-1500:],
+                       "wall_s": wall})
+        print(f"[suite] {tag}: FAILED rc={proc.returncode}\n"
+              f"{proc.stderr.strip()[-800:]}", file=sys.stderr, flush=True)
+        return
+    if artifact is not None:
+        rec = {"stage": tag, "artifact": artifact,
+               "artifact_exists": os.path.exists(
+                   os.path.join(REPO, artifact)),
+               "stdout_tail": proc.stdout.strip()[-1200:]}
+    else:
+        line = (proc.stdout.strip().splitlines()[-1]
+                if proc.stdout.strip() else "")
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            rec = {"stage": tag, "failed": "bad-output",
+                   "stdout_tail": proc.stdout.strip()[-500:]}
+    rec["wall_s"] = wall
+    append_result(rec)
+    print(f"[suite] {tag}: done in {wall}s", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stages", default=",".join(STAGES))
+    ap.add_argument("--child", default=None)
+    ap.add_argument("--wave", type=int, default=64)
+    ap.add_argument("--conv-impl", default="direct")
+    args = ap.parse_args()
+
+    if args.child:
+        if args.child == "conv":
+            print(json.dumps(child_conv()))
+        elif args.child == "bert":
+            print(json.dumps(child_bert()))
+        elif args.child == "wave1024":
+            print(json.dumps(child_wave1024(args.wave, args.conv_impl)))
+        else:
+            raise SystemExit(f"unknown child {args.child}")
+        return
+
+    me = os.path.abspath(__file__)
+    py = sys.executable
+    stages = args.stages.split(",")
+    for stage in stages:
+        if stage == "headline":
+            run_child([py, os.path.join(REPO, "bench.py")], 600, "headline",
+                      {"BATON_BENCH_BUDGET_S": "420"})
+        elif stage == "conv":
+            run_child([py, me, "--child", "conv"], 900, "conv")
+        elif stage == "headline_im2col":
+            run_child([py, os.path.join(REPO, "bench.py")], 600,
+                      "headline_im2col",
+                      {"BATON_BENCH_BUDGET_S": "420",
+                       "BATON_BENCH_CONV_IMPL": "im2col"})
+        elif stage == "bert":
+            run_child([py, me, "--child", "bert"], 900, "bert")
+        elif stage == "wave1024":
+            for w in (64, 32):
+                run_child([py, me, "--child", "wave1024", "--wave", str(w)],
+                          900, f"wave1024_w{w}")
+        elif stage == "attn":
+            run_child(
+                [py, os.path.join(REPO, "benchmarks", "attention_sweep.py")],
+                1800, "attn",
+                artifact="benchmarks/attention_sweep_tpu.json")
+        else:
+            print(f"[suite] unknown stage {stage}", file=sys.stderr)
+    print(f"[suite] all stages done -> {OUT_JSONL}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Poll the tunneled TPU until it answers; write /tmp/tpu_alive on success.
+# Each probe is a killable subprocess (a hung init cannot be cancelled
+# in-process). Used during development to catch the tunnel's live window
+# early in a session (it goes dark for hours after OOMs/round-end runs).
+LOG=${1:-/tmp/tpu_watch.log}
+FLAG=/tmp/tpu_alive
+rm -f "$FLAG"
+i=0
+while true; do
+  i=$((i+1))
+  echo "[$(date +%H:%M:%S)] probe $i starting" >> "$LOG"
+  out=$(timeout 150 python -c "
+import time, jax
+t=time.time()
+d=jax.devices()
+print('ALIVE', d[0].platform, d[0].device_kind, 'init_s=%.1f'%(time.time()-t))
+" 2>>"$LOG")
+  if echo "$out" | grep -q ALIVE; then
+    echo "[$(date +%H:%M:%S)] $out" | tee -a "$LOG" > "$FLAG"
+    exit 0
+  fi
+  echo "[$(date +%H:%M:%S)] probe $i dead/hung" >> "$LOG"
+  sleep 150
+done

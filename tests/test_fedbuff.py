@@ -193,3 +193,78 @@ def test_fedbuff_rejects_server_optimizer(setup):
                      server_optimizer=optax.adam(1e-2))
     with pytest.raises(ValueError):
         FedBuff(opt_sim)
+
+
+def test_mesh_fedbuff_matches_single_device(nprng):
+    """The sharded buffer (shard_map over the clients mesh) must be the
+    same function as the single-device vmap: identical params, staleness
+    accounting, and loss history from the same seed."""
+    from baton_tpu.parallel.mesh import make_mesh
+
+    model = linear_regression_model(10)
+    datasets = [linear_client_data(nprng) for _ in range(8)]
+    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    sim_1d = FedSim(model, batch_size=32, learning_rate=0.02)
+    sim_mesh = FedSim(model, batch_size=32, learning_rate=0.02,
+                      mesh=make_mesh(4))
+    params = sim_1d.init(jax.random.key(0))
+
+    out = {}
+    for name, sim in [("single", sim_1d), ("mesh", sim_mesh)]:
+        fb = FedBuff(sim, buffer_size=4, concurrency=8, alpha=0.5)
+        out[name] = fb.run(params, data, n_samples, jax.random.key(7),
+                           n_steps=6, n_epochs=2)
+    assert out["mesh"].version == out["single"].version
+    assert out["mesh"].mean_staleness == out["single"].mean_staleness
+    np.testing.assert_allclose(out["mesh"].loss_history,
+                               out["single"].loss_history, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(out["single"].params),
+                    jax.tree_util.tree_leaves(out["mesh"].params)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_mesh_fedbuff_validation(nprng):
+    """Buffer must shard evenly (no phantom padding of an async buffer),
+    and hybrid meshes are rejected at construction."""
+    from jax.sharding import Mesh
+    from baton_tpu.parallel.mesh import make_mesh
+
+    model = linear_regression_model(10)
+    sim = FedSim(model, batch_size=32, mesh=make_mesh(4))
+    with pytest.raises(ValueError, match="multiple"):
+        FedBuff(sim, buffer_size=6, concurrency=12)
+    devs = np.asarray(jax.devices()[:4]).reshape(2, 2)
+    hybrid = FedSim(model, batch_size=32,
+                    mesh=Mesh(devs, ("clients", "model")))
+    with pytest.raises(ValueError, match="hybrid"):
+        FedBuff(hybrid, buffer_size=2, concurrency=4)
+
+
+def test_fedbuff_high_concurrency_64_in_flight(nprng):
+    """Scale regression (VERDICT r3 item 5): 64 clients in flight over a
+    client cohort of 16, sharded over the full 8-device mesh. Checks the
+    queue math at depth (staleness under 64/16 overlap is deterministic)
+    and that training still converges toward the demo coefficients."""
+    from baton_tpu.parallel.mesh import make_mesh
+
+    model = linear_regression_model(10)
+    datasets = [linear_client_data(nprng) for _ in range(16)]
+    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    sim = FedSim(model, batch_size=32, learning_rate=0.02,
+                 mesh=make_mesh(8))
+    params = sim.init(jax.random.key(0))
+    fb = FedBuff(sim, buffer_size=16, concurrency=64, alpha=0.5)
+    res = fb.run(params, data, n_samples, jax.random.key(3),
+                 n_steps=12, n_epochs=1)
+    assert res.version == 12
+    # first buffer flush is staleness 0; once the 64-deep pipe is full,
+    # every flush drains updates anchored 64/16 = 4 flushes back
+    assert 2.0 < res.mean_staleness < 4.0
+    assert res.loss_history[-1] < res.loss_history[0] * 0.5
