@@ -52,3 +52,32 @@ def timed(fn: Callable, *args: Any, **kwargs: Any) -> Tuple[Any, float]:
     out = fn(*args, **kwargs)
     jax.block_until_ready(out)
     return out, time.perf_counter() - t0
+
+
+def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None):
+    """Best-available peak-HBM estimate for a single-program workload.
+
+    Prefers the runtime allocator's ``peak_bytes_in_use``; when the
+    runtime surfaces no allocator stats (the tunneled axon TPU reports
+    none — observed every round-3 run), falls back to XLA's static
+    memory plan for ``jitted(*args)``: arguments + outputs + temps minus
+    aliased buffers — the compiler's own HBM budget for the program, a
+    lower bound on (and in practice ~equal to) the allocator peak.
+    Returns GiB (float) or None when neither source is available.
+    """
+    try:
+        stats = device.memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use", 0)
+        if peak:
+            return round(peak / 2**30, 3)
+    except Exception:
+        pass
+    if jitted is not None and args is not None:
+        try:
+            ma = jitted.lower(*args).compile().memory_analysis()
+            tot = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                   + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+            return round(tot / 2**30, 3) if tot > 0 else None
+        except Exception:
+            return None
+    return None

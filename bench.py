@@ -399,18 +399,32 @@ def main() -> None:
     # null on the CPU liveness fallback.
     mfu = None
     peak_hbm_gb = None
+    peak_hbm_source = None
     device_kind = getattr(devs[0], "device_kind", platform)
     if not degraded:
         peak = next((v for k, v in TPU_PEAK_FLOPS.items()
                      if device_kind.startswith(k)), None)
         if peak:
             mfu = samples_per_sec * TRAIN_FLOPS_PER_IMG / peak
+    # allocator peak when surfaced; XLA's static memory plan for the
+    # round's wave kernel otherwise (the axon tunnel reports no
+    # allocator stats — utils/profiling.py::peak_hbm_gb)
+    from baton_tpu.utils.profiling import peak_hbm_gb as _peak_hbm
+
     try:
-        stats = devs[0].memory_stats()
-        if stats and "peak_bytes_in_use" in stats:
-            peak_hbm_gb = round(stats["peak_bytes_in_use"] / 2**30, 3)
+        rngs = jax.random.split(key, n_clients)
+        jitted = jax.jit(lambda pr, d, n, r: sim._wave_sums_raw(
+            pr, None, d, n, r, N_EPOCHS))
+        hbm_args = (p, data, n_samples, rngs)
     except Exception:
-        pass
+        jitted = hbm_args = None
+    peak_hbm_gb = _peak_hbm(devs[0], jitted, hbm_args)
+    if peak_hbm_gb is not None:
+        try:
+            alloc = (devs[0].memory_stats() or {}).get("peak_bytes_in_use")
+        except Exception:
+            alloc = None
+        peak_hbm_source = "allocator" if alloc else "xla_memory_analysis"
 
     # Honest metric naming (VERDICT r2 weak item 2): a degraded run measures
     # a DIFFERENT experiment (toy CNN, fewer clients, host CPU) — its JSON
@@ -440,6 +454,7 @@ def main() -> None:
         "samples_per_sec_per_chip": round(samples_per_sec, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "peak_hbm_gb": peak_hbm_gb,
+        "peak_hbm_source": peak_hbm_source,
         "dispatch_rounds_per_sec": round(rounds_per_sec, 3),
         "fused_rounds_per_sec": round(fused_rps, 3) if fused_rps else None,
         "attention_bench": attn_bench,
