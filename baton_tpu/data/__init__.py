@@ -13,6 +13,7 @@ from baton_tpu.data.datasets import (
     DatasetUnavailable,
     load_ag_news,
     load_cifar10,
+    load_digits_real,
     load_mnist,
 )
 
@@ -27,5 +28,6 @@ __all__ = [
     "DatasetUnavailable",
     "load_ag_news",
     "load_cifar10",
+    "load_digits_real",
     "load_mnist",
 ]

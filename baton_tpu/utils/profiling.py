@@ -54,7 +54,8 @@ def timed(fn: Callable, *args: Any, **kwargs: Any) -> Tuple[Any, float]:
     return out, time.perf_counter() - t0
 
 
-def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None):
+def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None
+                ) -> Tuple[Optional[float], Optional[str]]:
     """Best-available peak-HBM estimate for a single-program workload.
 
     Prefers the runtime allocator's ``peak_bytes_in_use``; when the
@@ -63,13 +64,16 @@ def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None):
     memory plan for ``jitted(*args)``: arguments + outputs + temps minus
     aliased buffers — the compiler's own HBM budget for the program, a
     lower bound on (and in practice ~equal to) the allocator peak.
-    Returns GiB (float) or None when neither source is available.
+    Returns ``(GiB, source)`` with source ``"allocator"`` /
+    ``"xla_memory_analysis"``, or ``(None, None)`` when neither is
+    available. Note the fallback COMPILES ``jitted`` if it isn't
+    already cached — callers on a wall-clock budget must gate on it.
     """
     try:
         stats = device.memory_stats() or {}
         peak = stats.get("peak_bytes_in_use", 0)
         if peak:
-            return round(peak / 2**30, 3)
+            return round(peak / 2**30, 3), "allocator"
     except Exception:
         pass
     if jitted is not None and args is not None:
@@ -77,7 +81,8 @@ def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None):
             ma = jitted.lower(*args).compile().memory_analysis()
             tot = (ma.argument_size_in_bytes + ma.output_size_in_bytes
                    + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
-            return round(tot / 2**30, 3) if tot > 0 else None
+            if tot > 0:
+                return round(tot / 2**30, 3), "xla_memory_analysis"
         except Exception:
-            return None
-    return None
+            pass
+    return None, None

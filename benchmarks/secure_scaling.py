@@ -28,12 +28,23 @@ from baton_tpu.server import secure as S
 
 COHORTS = (8, 16, 32, 64, 128)
 MODEL_SIZES = {"linear_11": 11, "cnn_50k": 50_000, "resnet18_11.7m": 11_700_000}
-# measured C=16 cells for the big model, filled in run order and used to
-# extrapolate C>16 (linear in C-1 peer masks)
-_RESNET_BASE: dict = {}
+_CALIB_C = 16  # cohort size at which big-model mask cost is measured
 
 
-def bench_cohort(C: int) -> dict:
+def _measure_mask(n_params: int, n_peers: int) -> float:
+    seeds = {f"client_{j:04d}": os.urandom(32) for j in range(n_peers)}
+    state = {"w": np.ones((n_params,), np.float64)}
+    t0 = time.perf_counter()
+    S.mask_state_dict(state, "client_zzzz", seeds, self_seed=os.urandom(32))
+    return round(time.perf_counter() - t0, 3)
+
+
+def bench_cohort(C: int, big_model_base: dict) -> dict:
+    """``big_model_base`` maps model name -> measured mask seconds at
+    ``_CALIB_C`` members; C > _CALIB_C cells extrapolate linearly in the
+    peer count (C−1) from that SAME model's measurement — cross-model
+    parameter scaling underestimates ~3x (overhead-dominated small
+    cells)."""
     t = C // 2 + 1
     rec = {"C": C, "t": t}
 
@@ -64,28 +75,15 @@ def bench_cohort(C: int) -> dict:
     S.shamir_reconstruct(sub)
     rec["shamir_reconstruct_s"] = round(time.perf_counter() - t0, 4)
 
-    seeds = {f"client_{j:04d}": os.urandom(32) for j in range(C - 1)}
     rec["mask_per_client_s"] = {}
     for name, n_params in MODEL_SIZES.items():
-        if n_params > 1_000_000 and C > 16:
-            # extrapolate the big model at large C from its OWN measured
-            # C=16 cell (cost is linear in the number of peer masks,
-            # C-1); cross-model scaling by parameter count underestimates
-            # ~3x because small-model cells are overhead-dominated
-            base = _RESNET_BASE.get(name)
-            if base is not None:
-                rec["mask_per_client_s"][name] = round(
-                    base * (C - 1) / 15.0, 3)
-                rec.setdefault("extrapolated", []).append(name)
-                continue
-        state = {"w": np.ones((n_params,), np.float64)}
-        t0 = time.perf_counter()
-        S.mask_state_dict(state, "client_zzzz", seeds,
-                          self_seed=os.urandom(32))
-        dt = round(time.perf_counter() - t0, 3)
-        rec["mask_per_client_s"][name] = dt
-        if C == 16 and n_params > 1_000_000:
-            _RESNET_BASE[name] = dt
+        base = big_model_base.get(name)
+        if n_params > 1_000_000 and C > _CALIB_C and base is not None:
+            rec["mask_per_client_s"][name] = round(
+                base * (C - 1) / (_CALIB_C - 1), 3)
+            rec.setdefault("extrapolated", []).append(name)
+        else:
+            rec["mask_per_client_s"][name] = _measure_mask(n_params, C - 1)
 
     # serialized whole-cohort estimate (everything every party does, run
     # on one core — the shape of the in-process integration test; a real
@@ -98,7 +96,12 @@ def bench_cohort(C: int) -> dict:
 
 
 def main() -> None:
-    out = {"results": [bench_cohort(C) for C in COHORTS]}
+    # calibrate big-model mask cost once, independent of COHORTS order
+    big_model_base = {
+        name: _measure_mask(n_params, _CALIB_C - 1)
+        for name, n_params in MODEL_SIZES.items() if n_params > 1_000_000
+    }
+    out = {"results": [bench_cohort(C, big_model_base) for C in COHORTS]}
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "secure_scaling.json")
     with open(path, "w") as f:
