@@ -86,3 +86,40 @@ def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None
         except Exception:
             pass
     return None, None
+
+
+def fedsim_wave_hbm(device, sim, params, data, n_samples, key,
+                    wave_size: Optional[int] = None, n_epochs: int = 1,
+                    remaining_s: Optional[float] = None,
+                    ) -> Tuple[Optional[float], Optional[str]]:
+    """Peak-HBM estimate for one wave of a :class:`FedSim` round.
+
+    Allocator stats when available (cheap); otherwise lowers ONE wave's
+    kernel (``_wave_sums_raw`` with no frozen partition — callers with a
+    LoRA split need their own program) for XLA's static plan. Lowering
+    compiles a fresh program, so when ``remaining_s`` is given the
+    fallback is skipped below a 60 s floor — a slow tunnel compile must
+    never turn an already-measured benchmark into a timeout. This is the
+    single shared implementation for bench.py / wave_sweep.py /
+    r4_tpu_suite.py (it was once four copies).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    gb, src = peak_hbm_gb(device)
+    if gb is not None:
+        return gb, src
+    if remaining_s is not None and remaining_s < 60.0:
+        return None, None
+    try:
+        n_samples = jnp.asarray(n_samples)
+        if wave_size is None:
+            wave_size = int(n_samples.shape[0])
+        d0 = jax.tree_util.tree_map(lambda a: a[:wave_size], data)
+        n0 = n_samples[:wave_size]
+        r0 = jax.random.split(key, wave_size)
+        jitted = jax.jit(lambda pr, d, n, r: sim._wave_sums_raw(
+            pr, None, d, n, r, n_epochs))
+        return peak_hbm_gb(device, jitted, (params, d0, n0, r0))
+    except Exception:
+        return None, None

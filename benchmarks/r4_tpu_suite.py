@@ -17,8 +17,14 @@ hang/crash cannot take out the rest; results append to
                        round, FLOPs from XLA cost analysis (item 2b;
                        target measured mfu >= 0.2)
 5. ``wave1024``      — the north-star cohort: 1024 clients in waves of
-                       {32, 64}, rounds/s + per-wave peak HBM (item 4)
-6. ``attn``          — attention_sweep.py, L in {1024..8192} x blocks,
+                       {32, 64} using the conv-shootout winner, rounds/s
+                       + per-wave peak HBM (item 4)
+6. ``wave1024_fused`` — 3 rounds of the 16-wave 1024-client round as ONE
+                       lax.scan dispatch (item 4's fused variant)
+7. ``wave128``       — refresh the 128-client wave sweep with the HBM
+                       column via wave_sweep.py --waves 16,32,64 (no
+                       full-cohort wave: that OOM killed the r3 tunnel)
+8. ``attn``          — attention_sweep.py, L in {1024..8192} x blocks,
                        dense capped at 4096 to avoid the OOM that killed
                        the r3 tunnel (item 7)
 
@@ -346,16 +352,10 @@ def child_wave1024(wave_size: int, conv_impl: str = "direct") -> dict:
 
     # per-wave static HBM plan (the allocator peak is invisible through
     # the tunnel): one wave's program on wave-sized inputs
-    jitted = hbm_args = None
-    try:
-        d0 = jax.tree_util.tree_map(lambda a: a[:wave_size], data)
-        n0 = n_samples[:wave_size]
-        r0 = jax.random.split(key, wave_size)
-        jitted = jax.jit(
-            lambda pr, d, n, r: sim._wave_sums_raw(pr, None, d, n, r, 1))
-        hbm_args = (p, d0, n0, r0)
-    except Exception:
-        pass
+    from baton_tpu.utils.profiling import fedsim_wave_hbm
+
+    hbm = fedsim_wave_hbm(dev, sim, p, data, n_samples, key,
+                          wave_size=wave_size)[0]
     return {
         "stage": "wave1024", "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
@@ -368,7 +368,7 @@ def child_wave1024(wave_size: int, conv_impl: str = "direct") -> dict:
         "mfu_analytic": round(
             sps * RESNET_TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16, 4),
         "compile_s": round(compile_s, 1),
-        "peak_hbm_gb": _peak_hbm_gb(dev, jitted, hbm_args),
+        "peak_hbm_gb": hbm,
         # the honest extrapolation: a v4-32 runs 32 of these shards in
         # parallel (one 32-client shard each) + one psum round boundary
         "v4_32_extrapolation_note": (
@@ -431,16 +431,10 @@ def child_wave1024_fused(wave_size: int, conv_impl: str = "direct") -> dict:
     # static HBM plan of one wave's kernel — the dominant footprint of
     # the fused program too (the scan carries only the params/opt
     # accumulators between waves); the tunnel surfaces no allocator peak
-    jitted = hbm_args = None
-    try:
-        d0 = jax.tree_util.tree_map(lambda a: a[:wave_size], data)
-        n0 = n_samples[:wave_size]
-        r0 = jax.random.split(key, wave_size)
-        jitted = jax.jit(
-            lambda pr, d, n, r: sim._wave_sums_raw(pr, None, d, n, r, 1))
-        hbm_args = (p, d0, n0, r0)
-    except Exception:
-        pass
+    from baton_tpu.utils.profiling import fedsim_wave_hbm
+
+    hbm = fedsim_wave_hbm(dev, sim, p, data, n_samples, key,
+                          wave_size=wave_size)[0]
     return {
         "stage": "wave1024_fused", "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
@@ -452,7 +446,7 @@ def child_wave1024_fused(wave_size: int, conv_impl: str = "direct") -> dict:
         "mfu_analytic": round(
             sps * RESNET_TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16, 4),
         "compile_s": round(compile_s, 1),
-        "peak_hbm_gb": _peak_hbm_gb(dev, jitted, hbm_args),
+        "peak_hbm_gb": hbm,
         "peak_hbm_note": "per-wave kernel plan (fused scan adds only "
                          "params/opt accumulators)",
         "final_loss": float(hist[-1]),
@@ -461,7 +455,7 @@ def child_wave1024_fused(wave_size: int, conv_impl: str = "direct") -> dict:
 
 # ======================================================================
 STAGES = ("headline", "conv", "headline_im2col", "bert", "wave1024",
-          "wave1024_fused", "attn")
+          "wave1024_fused", "wave128", "attn")
 
 
 def _conv_winner(default: str = "direct") -> str:
@@ -586,6 +580,14 @@ def main() -> None:
             run_child([py, me, "--child", "wave1024_fused", "--wave", "64",
                        "--conv-impl", impl],
                       1200, f"wave1024_fused_{impl}")
+        elif stage == "wave128":
+            # refresh the 128-client sweep with the HBM column; no wave
+            # 128 (the full-cohort OOM killed the r3 tunnel for hours)
+            run_child(
+                [py, os.path.join(REPO, "benchmarks", "wave_sweep.py"),
+                 "--waves", "16,32,64"],
+                1500, "wave128",
+                artifact="benchmarks/wave_sweep_tpu.json")
         elif stage == "attn":
             run_child(
                 [py, os.path.join(REPO, "benchmarks", "attention_sweep.py")],

@@ -36,6 +36,8 @@ CHILD_TIMEOUT_S = 420.0
 
 
 def run_one(wave_size: int) -> dict:
+    t_child = time.perf_counter()
+
     import jax
 
     jax.config.update(
@@ -90,21 +92,25 @@ def run_one(wave_size: int) -> dict:
     float(res.loss_history[-1])
     dt = time.perf_counter() - t0
 
-    stats = dev.memory_stats() or {}
-    peak = stats.get("peak_bytes_in_use", 0)
+    # allocator peak, or XLA's static plan for one wave's kernel when
+    # the tunnel surfaces no allocator stats (r3: every peak was 0);
+    # budget-gated so the extra compile can't timeout a measured child
+    from baton_tpu.utils.profiling import fedsim_wave_hbm
+
+    peak, peak_src = fedsim_wave_hbm(
+        dev, sim, p, data, n_samples, key, wave_size=wave_size,
+        n_epochs=N_EPOCHS,
+        remaining_s=CHILD_TIMEOUT_S - (time.perf_counter() - t_child))
     rec = {
         "wave_size": wave_size,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "clients": N_CLIENTS,
         "rounds_per_sec": round(iters / dt, 3),
-        "peak_hbm_gb": round(peak / 2**30, 3),
+        "peak_hbm_gb": peak,
+        "peak_hbm_source": peak_src,
         "compile_s": round(compile_s, 1),
     }
-    if not peak:
-        # the axon-tunneled runtime may not surface allocator stats —
-        # keep whatever it DID report so a zero peak is diagnosable
-        rec["memory_stats_raw"] = {k: int(v) for k, v in stats.items()}
     return rec
 
 
@@ -112,6 +118,13 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--wave", type=int, default=None,
                     help="run one setting and print its JSON line (child mode)")
+    ap.add_argument("--waves", default=None,
+                    help="comma-separated sweep settings (default "
+                         f"{','.join(map(str, WAVES))}). Note: wave 128 "
+                         "(full cohort) OOMs one v5e chip AND puts the "
+                         "tunneled TPU into multi-hour recovery "
+                         "(TPU_EVIDENCE_r3.md) — pass 16,32,64 when the "
+                         "chip is needed afterwards.")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "wave_sweep_tpu.json"))
     args = ap.parse_args()
@@ -120,8 +133,10 @@ def main() -> None:
         print(json.dumps(run_one(args.wave)))
         return
 
+    waves = (tuple(int(x) for x in args.waves.split(","))
+             if args.waves else WAVES)
     results = []
-    for w in WAVES:
+    for w in waves:
         t0 = time.perf_counter()
         env = dict(os.environ)
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -169,8 +184,10 @@ def main() -> None:
             continue
         rec["wall_s"] = round(time.perf_counter() - t0, 1)
         results.append(rec)
+        hbm = rec.get("peak_hbm_gb")
+        hbm_txt = f"{hbm:6.3f} GB" if hbm is not None else "   n/a"
         print(f"wave {w:4d}: {rec['rounds_per_sec']:6.3f} rounds/s  "
-              f"peak HBM {rec['peak_hbm_gb']:6.3f} GB  "
+              f"peak HBM {hbm_txt}  "
               f"(compile {rec['compile_s']}s)", file=sys.stderr)
 
     out = {
