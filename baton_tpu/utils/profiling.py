@@ -73,7 +73,9 @@ def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None
         stats = device.memory_stats() or {}
         peak = stats.get("peak_bytes_in_use", 0)
         if peak:
-            return round(peak / 2**30, 3), "allocator"
+            # 6 decimals on both branches: a sub-MB peak must not round
+            # to a deceptive 0.0 GiB
+            return round(peak / 2**30, 6), "allocator"
     except Exception:
         pass
     if jitted is not None and args is not None:
@@ -82,7 +84,9 @@ def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None
             tot = (ma.argument_size_in_bytes + ma.output_size_in_bytes
                    + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
             if tot > 0:
-                return round(tot / 2**30, 3), "xla_memory_analysis"
+                # 6 decimals: tiny test programs must not round to a
+                # deceptive 0.0 GiB (real wave kernels are >= MBs)
+                return round(tot / 2**30, 6), "xla_memory_analysis"
         except Exception:
             pass
     return None, None
