@@ -72,6 +72,26 @@ def main():
             d = None
             print(f"L={L} dense skipped (scores tensor would OOM; "
                   f"--dense-max {args.dense_max})")
+        # reference point: the Pallas TPU flash kernel SHIPPED WITH JAX
+        # (jax.experimental.pallas.ops.tpu) at its default block sizes —
+        # if the library kernel beats ours at a length, the dispatch in
+        # models/transformer.py should route there instead
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as jax_flash,
+            )
+
+            def jf(q, k, v, causal=False, **kw):
+                return jax_flash(q, k, v, causal=causal,
+                                 sm_scale=q.shape[-1] ** -0.5)
+
+            jm = timeit(jf, L)
+            rec["jax_pallas_ms"] = round(jm, 2)
+            ratio = f" ({d / jm:.2f}x vs dense)" if d else ""
+            print(f"  jax-shipped pallas kernel: {jm:.2f} ms{ratio}")
+        except Exception as e:
+            rec["jax_pallas_error"] = f"{type(e).__name__}: {e}"[:200]
+            print(f"  jax-shipped pallas kernel failed: {e}")
         for spec in args.blocks.split(","):
             bq, bk = (int(x) for x in spec.split("x"))
             if bq > L or bk > L:

@@ -1,0 +1,96 @@
+"""Real bytes, zero egress: federated CNN on sklearn's bundled digits.
+
+Every other recipe trains on synthetic stand-ins because this
+environment has no network egress; this one trains on the REAL UCI
+handwritten-digits images that ship inside scikit-learn
+(baton_tpu.data.load_digits_real) — 1797 8x8 grayscale digits, split
+into non-IID Dirichlet client shards, with accuracy reported on a
+held-out REAL test split. Reaches ~0.96 held-out accuracy in ~20
+rounds on CPU in under a minute.
+
+Usage:
+    python examples/10_real_digits.py [--clients 8] [--rounds 20]
+        [--alpha 0.5] [--mesh] [--fedbuff]
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from baton_tpu.data import dirichlet_partition, load_digits_real
+from baton_tpu.models.cnn import cnn_mnist_model
+from baton_tpu.ops.padding import stack_client_datasets
+from baton_tpu.parallel.engine import FedSim
+from baton_tpu.parallel.mesh import make_mesh
+
+
+def run(n_clients=8, n_rounds=20, n_epochs=2, alpha=0.5, batch_size=32,
+        use_mesh=False, fedbuff=False, seed=0):
+    train, test, info = load_digits_real(seed=seed)
+    print(f"dataset: {info['dataset']} (real={info['real']}) "
+          f"train={info['n_train']} test={info['n_test']}")
+
+    rng = np.random.default_rng(seed)
+    clients = dirichlet_partition(train, n_clients=n_clients, rng=rng,
+                                  alpha=alpha, min_samples=batch_size // 4)
+    sizes = [len(c["y"]) for c in clients]
+    print(f"{n_clients} Dirichlet(alpha={alpha}) shards, "
+          f"sizes {min(sizes)}..{max(sizes)}")
+
+    data, n_samples = stack_client_datasets(clients, batch_size=batch_size)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    mesh = None
+    if use_mesh and len(jax.devices()) > 1:
+        mesh = make_mesh(len(jax.devices()))
+        print(f"clients mesh over {mesh.devices.size} devices")
+
+    model = cnn_mnist_model(image_size=8, channels=1, width=16,
+                            name="cnn_digits")
+    sim = FedSim(model, batch_size=batch_size, learning_rate=0.1, mesh=mesh)
+    params = sim.init(jax.random.key(seed))
+
+    if fedbuff:
+        from baton_tpu.parallel.fedbuff import FedBuff
+
+        n_dev = mesh.devices.size if mesh is not None else 1
+        buf = max(n_clients // 2, n_dev)
+        fb = FedBuff(sim, buffer_size=buf, concurrency=2 * buf, alpha=0.5)
+        res = fb.run(params, data, n_samples, jax.random.key(seed + 1),
+                     n_steps=n_rounds, n_epochs=n_epochs)
+        params = res.params
+        print(f"async FedBuff: {n_rounds} server steps, "
+              f"mean staleness {res.mean_staleness:.2f}, "
+              f"final step loss {res.loss_history[-1]:.4f}")
+    else:
+        params, hist = sim.run_rounds(params, data, n_samples,
+                                      jax.random.key(seed + 1),
+                                      n_rounds=n_rounds, n_epochs=n_epochs)
+        print(f"sync FedAvg: loss {hist[0]:.4f} -> {hist[-1]:.4f}")
+
+    ts, tn = stack_client_datasets([test], batch_size=64)
+    m = sim.evaluate_round(params, {k: jnp.asarray(v) for k, v in ts.items()},
+                           jnp.asarray(tn))
+    print(f"held-out REAL-data accuracy: {m['accuracy']:.4f} "
+          f"(n={int(m['n'])})")
+    return m["accuracy"]
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--rounds", type=int, default=20)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--alpha", type=float, default=0.5)
+    p.add_argument("--mesh", action="store_true")
+    p.add_argument("--fedbuff", action="store_true")
+    p.add_argument("--cpu", action="store_true",
+                   help="force CPU (the tunneled TPU can hang on init)")
+    args = p.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    run(n_clients=args.clients, n_rounds=args.rounds, n_epochs=args.epochs,
+        alpha=args.alpha, use_mesh=args.mesh, fedbuff=args.fedbuff)

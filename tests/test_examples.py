@@ -132,3 +132,13 @@ def test_long_context_striped():
     m = _load("06_long_context_ring")
     losses = m.run(n_steps=3, striped=True)
     assert losses[-1] < losses[0]
+
+
+def test_real_digits():
+    """The repo's accuracy claim on REAL bytes (canonical recipe —
+    tests/test_datasets.py covers the loader contract only): 8 non-IID
+    Dirichlet shards of sklearn's real digit images to >0.85 held-out
+    accuracy (observed ~0.95; chance is 0.1)."""
+    m = _load("10_real_digits")
+    acc = m.run(n_clients=8, n_rounds=20, n_epochs=2)
+    assert acc > 0.85
