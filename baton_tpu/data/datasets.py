@@ -328,9 +328,10 @@ def load_digits_real(test_fraction: float = 0.2, seed: int = 0
 
     This exists so at least one recorded training run uses REAL bytes
     (every other loader needs network or pre-staged files and otherwise
-    falls back to labelled synthetic surrogates): accuracy measured on
-    this split is a real-dataset number and is quoted as such in
-    tests/test_datasets.py.
+    falls back to labelled synthetic surrogates). The loader contract is
+    pinned in tests/test_datasets.py; the real-data accuracy bar lives
+    with the canonical recipe (examples/10_real_digits.py, run by
+    tests/test_examples.py::test_real_digits).
     """
     try:
         from sklearn.datasets import load_digits as _ld

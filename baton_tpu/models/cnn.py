@@ -3,6 +3,10 @@
 Written directly against ``jax.lax.conv_general_dilated`` (NHWC) so the
 convs land on the MXU without framework overhead; params are a plain
 dict pytree, vmappable over the client axis like every other model.
+``conv_impl="im2col"`` switches to the patch-slices + batched-matmul
+lowering shared with the ResNet (models/resnet.py::_conv_im2col) — the
+MXU-friendly form for vmapped per-client training, where a direct conv
+with batched weights lowers to a C-group grouped convolution.
 """
 
 from __future__ import annotations
@@ -12,17 +16,11 @@ import jax.numpy as jnp
 
 from baton_tpu.core.losses import softmax_cross_entropy
 from baton_tpu.core.model import FedModel
+from baton_tpu.models.resnet import _CONV_IMPLS, _conv as _resnet_conv
 
 
-def _conv(x, w, b):
-    out = jax.lax.conv_general_dilated(
-        x,
-        w,
-        window_strides=(1, 1),
-        padding="SAME",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
-    return out + b
+def _conv(x, w, b, impl="direct"):
+    return _resnet_conv(x, w, 1, impl) + b
 
 
 def cnn_mnist_model(
@@ -30,8 +28,14 @@ def cnn_mnist_model(
     channels: int = 1,
     n_classes: int = 10,
     width: int = 32,
+    conv_impl: str = "direct",
     name: str = "cnn_mnist",
 ) -> FedModel:
+    if conv_impl not in _CONV_IMPLS:
+        raise ValueError(
+            f"conv_impl must be one of {sorted(_CONV_IMPLS)}, got "
+            f"{conv_impl!r}"
+        )
     reduced = image_size // 4  # two 2x2 maxpools
 
     def init(rng):
@@ -63,11 +67,13 @@ def cnn_mnist_model(
         x = batch["x"]
         if x.ndim == 3:
             x = x[..., None]
-        x = jax.nn.relu(_conv(x, params["conv1"]["w"], params["conv1"]["b"]))
+        x = jax.nn.relu(_conv(x, params["conv1"]["w"], params["conv1"]["b"],
+                              conv_impl))
         x = jax.lax.reduce_window(
             x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
         )
-        x = jax.nn.relu(_conv(x, params["conv2"]["w"], params["conv2"]["b"]))
+        x = jax.nn.relu(_conv(x, params["conv2"]["w"], params["conv2"]["b"],
+                              conv_impl))
         x = jax.lax.reduce_window(
             x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
         )

@@ -116,3 +116,29 @@ def test_im2col_resnet_vmapped_grads_match(nprng):
     for gd, gi in zip(jax.tree_util.tree_leaves(grad_d),
                       jax.tree_util.tree_leaves(grad_i)):
         np.testing.assert_allclose(gi, gd, rtol=5e-4, atol=5e-4)
+
+
+def test_cnn_im2col_matches_direct(nprng):
+    """The CNN shares the conv-lowering switch; both impls must be the
+    same function through a vmapped per-client grad."""
+    from baton_tpu.models.cnn import cnn_mnist_model
+
+    m_d = cnn_mnist_model(image_size=8, channels=1, width=4)
+    m_i = cnn_mnist_model(image_size=8, channels=1, width=4,
+                          conv_impl="im2col")
+    params = m_d.init(jax.random.key(0))
+    x = jnp.asarray(nprng.normal(size=(3, 2, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(nprng.integers(0, 10, size=(3, 2)), jnp.int32)
+
+    def per_client(model):
+        f = lambda p, xb, yb: jax.value_and_grad(lambda pp: jnp.mean(
+            model.per_example_loss(pp, {"x": xb, "y": yb},
+                                   jax.random.key(1))))(p)
+        return jax.vmap(f, in_axes=(None, 0, 0))(params, x, y)
+
+    loss_d, grad_d = per_client(m_d)
+    loss_i, grad_i = per_client(m_i)
+    np.testing.assert_allclose(loss_i, loss_d, rtol=1e-5, atol=1e-5)
+    for gd, gi in zip(jax.tree_util.tree_leaves(grad_d),
+                      jax.tree_util.tree_leaves(grad_i)):
+        np.testing.assert_allclose(gi, gd, rtol=5e-4, atol=5e-4)
