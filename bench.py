@@ -31,7 +31,11 @@ BUDGET_S = float(os.environ.get("BATON_BENCH_BUDGET_S", "420"))
 
 N_CLIENTS = 32           # one v4-32 chip's shard of 1024 clients
 SAMPLES_PER_CLIENT = 48  # ~50_000 / 1024
-BATCH_SIZE = 32
+# 48-sample clients at batch 32 train one full + one HALF-PADDED batch
+# per epoch (64 sample-slots of conv FLOPs for 48 real samples — 25%
+# waste); BATON_BENCH_BATCH=48 removes the padding batch. Default stays
+# 32 until the r4 suite's conv stage measures the win on hardware.
+BATCH_SIZE = int(os.environ.get("BATON_BENCH_BATCH", "32"))
 N_EPOCHS = 1
 TARGET_ROUNDS_PER_SEC = 10.0
 # r2 postmortem: a 90 s single-shot probe declared a *live* backend dead
@@ -441,6 +445,7 @@ def main() -> None:
         "model": model_name,
         "clients": n_clients,
         "samples_per_client": samples_per_client,
+        "batch_size": BATCH_SIZE,
         "compile_s": round(compile_s, 1),
         "samples_per_sec_per_chip": round(samples_per_sec, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,

@@ -174,12 +174,31 @@ def child_conv() -> dict:
         "x": rng.normal(size=(spc, img, img, 3)).astype(np.float32),
         "y": rng.integers(0, 10, size=(spc,)).astype(np.int32),
     } for _ in range(C)]
-    data, n_samples = stack_client_datasets(datasets, batch_size=spc)
-    data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
-    n_samples = jnp.asarray(n_samples)
+
+    _staged = {}
+
+    def stage(bs):
+        # cache per distinct batch size: both impls reuse one staging
+        if bs not in _staged:
+            d, n = stack_client_datasets(datasets, batch_size=bs)
+            _staged[bs] = (
+                {k: jax.device_put(jnp.asarray(v)) for k, v in d.items()},
+                jnp.asarray(n),
+            )
+        return _staged[bs]
+
     key = jax.random.key(1)
 
     from baton_tpu.models.resnet import resnet_model
+
+    # two lowering impls x two batchings. batch=32 over 48-sample
+    # clients (the bench headline config) trains one full batch + one
+    # HALF-PADDED batch per epoch — 64 sample-slots of conv FLOPs for
+    # 48 real samples (25% waste); batch=48 removes the padding batch
+    # entirely (VERDICT item 2a: "larger per-client batch via wave
+    # restructuring"). Identical FedAvg semantics, different SGD
+    # batching — reported as separate configs.
+    batch_sizes = (spc,) if SMOKE else (32, 48)
     for impl in ("direct", "im2col"):
         model = (resnet_model(blocks_per_stage=(1,), n_groups=4,
                               conv_impl=impl)
@@ -187,29 +206,33 @@ def child_conv() -> dict:
                  resnet18_cifar_model(compute_dtype=jnp.bfloat16,
                                       conv_impl=impl))
         params = model.init(jax.random.key(0))
-        sim = FedSim(model, batch_size=spc, learning_rate=0.05)
-        t_c = time.perf_counter()
-        res = sim.run_round(params, data, n_samples, key,
-                            collect_client_losses=False)
-        float(res.loss_history[-1])
-        compile_s = time.perf_counter() - t_c
-        iters, p = (2 if SMOKE else 12), res.params
-        t0 = time.perf_counter()
-        for i in range(iters):
-            res = sim.run_round(p, data, n_samples,
-                                jax.random.fold_in(key, i),
+        for bs in batch_sizes:
+            data, n_samples = stage(bs)  # capacity rounds to the batch
+            sim = FedSim(model, batch_size=bs, learning_rate=0.05)
+            t_c = time.perf_counter()
+            res = sim.run_round(params, data, n_samples, key,
                                 collect_client_losses=False)
-            p = res.params
-        float(res.loss_history[-1])
-        dt = (time.perf_counter() - t0) / iters
-        sps = C * spc / dt
-        out["full_model"][impl] = {
-            "rounds_per_sec": round(1 / dt, 3),
-            "samples_per_sec_per_chip": round(sps, 1),
-            "mfu_analytic": round(
-                sps * RESNET_TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16, 4),
-            "compile_s": round(compile_s, 1),
-        }
+            float(res.loss_history[-1])
+            compile_s = time.perf_counter() - t_c
+            iters, p = (2 if SMOKE else 12), res.params
+            t0 = time.perf_counter()
+            for i in range(iters):
+                res = sim.run_round(p, data, n_samples,
+                                    jax.random.fold_in(key, i),
+                                    collect_client_losses=False)
+                p = res.params
+            float(res.loss_history[-1])
+            dt = (time.perf_counter() - t0) / iters
+            sps = C * spc / dt
+            tag = impl if bs == 32 or SMOKE else f"{impl}_b{bs}"
+            out["full_model"][tag] = {
+                "batch_size": bs,
+                "rounds_per_sec": round(1 / dt, 3),
+                "samples_per_sec_per_chip": round(sps, 1),
+                "mfu_analytic": round(
+                    sps * RESNET_TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16, 4),
+                "compile_s": round(compile_s, 1),
+            }
     out["peak_hbm_gb"] = _peak_hbm_gb(dev)
     return out
 
@@ -299,7 +322,8 @@ def child_bert() -> dict:
 
 # ======================================================================
 # stage: wave1024 — the north-star cohort on one chip
-def child_wave1024(wave_size: int, conv_impl: str = "direct") -> dict:
+def child_wave1024(wave_size: int, conv_impl: str = "direct",
+                   batch_size: int = 32) -> dict:
     jax = _jax_setup()
     import jax.numpy as jnp
     import numpy as np
@@ -316,7 +340,8 @@ def child_wave1024(wave_size: int, conv_impl: str = "direct") -> dict:
         "x": rng.normal(size=(S, img, img, 3)).astype(np.float32),
         "y": rng.integers(0, 10, size=(S,)).astype(np.int32),
     } for _ in range(C)]
-    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    bs = S if SMOKE else batch_size
+    data, n_samples = stack_client_datasets(datasets, batch_size=bs)
     data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
     n_samples = jnp.asarray(n_samples)
 
@@ -329,9 +354,10 @@ def child_wave1024(wave_size: int, conv_impl: str = "direct") -> dict:
         model = resnet18_cifar_model(compute_dtype=jnp.bfloat16,
                                      conv_impl=conv_impl)
     params = model.init(jax.random.key(0))
-    # batch_size 32 matches bench.py's headline config (48-sample clients
-    # train one batch of 32 + one masked batch of 16)
-    sim = FedSim(model, batch_size=S if SMOKE else 32, learning_rate=0.05)
+    # batch_size comes from the conv shootout's winner (48 removes the
+    # half-padded second batch of the 48-sample clients; 32 mirrors the
+    # original headline config)
+    sim = FedSim(model, batch_size=bs, learning_rate=0.05)
     key = jax.random.key(1)
 
     t_c = time.perf_counter()
@@ -360,6 +386,7 @@ def child_wave1024(wave_size: int, conv_impl: str = "direct") -> dict:
         "stage": "wave1024", "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "model": f"resnet18_bf16_{conv_impl}", "clients": C,
+        "batch_size": bs,
         "samples_per_client": S, "wave_size": wave_size,
         "n_waves": -(-C // wave_size),
         "rounds_per_sec": round(1 / dt, 4),
@@ -381,7 +408,8 @@ def child_wave1024(wave_size: int, conv_impl: str = "direct") -> dict:
 # ======================================================================
 # stage: wave1024_fused — the whole 16-wave round inside lax.scan,
 # multi-round, one dispatch (VERDICT item 4's "fused-rounds variant")
-def child_wave1024_fused(wave_size: int, conv_impl: str = "direct") -> dict:
+def child_wave1024_fused(wave_size: int, conv_impl: str = "direct",
+                         batch_size: int = 32) -> dict:
     jax = _jax_setup()
     import jax.numpy as jnp
     import numpy as np
@@ -398,7 +426,8 @@ def child_wave1024_fused(wave_size: int, conv_impl: str = "direct") -> dict:
         "x": rng.normal(size=(S, img, img, 3)).astype(np.float32),
         "y": rng.integers(0, 10, size=(S,)).astype(np.int32),
     } for _ in range(C)]
-    data, n_samples = stack_client_datasets(datasets, batch_size=32)
+    bs = S if SMOKE else batch_size
+    data, n_samples = stack_client_datasets(datasets, batch_size=bs)
     data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
     n_samples = jnp.asarray(n_samples)
 
@@ -410,7 +439,7 @@ def child_wave1024_fused(wave_size: int, conv_impl: str = "direct") -> dict:
         model = resnet18_cifar_model(compute_dtype=jnp.bfloat16,
                                      conv_impl=conv_impl)
     params = model.init(jax.random.key(0))
-    sim = FedSim(model, batch_size=S if SMOKE else 32, learning_rate=0.05)
+    sim = FedSim(model, batch_size=bs, learning_rate=0.05)
     key = jax.random.key(1)
     n_rounds = 2 if SMOKE else 3
 
@@ -439,6 +468,7 @@ def child_wave1024_fused(wave_size: int, conv_impl: str = "direct") -> dict:
         "stage": "wave1024_fused", "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "model": f"resnet18_bf16_{conv_impl}", "clients": C,
+        "batch_size": bs,
         "samples_per_client": S, "wave_size": wave_size,
         "n_rounds_fused": n_rounds,
         "rounds_per_sec": round(1 / dt, 4),
@@ -458,26 +488,33 @@ STAGES = ("headline", "conv", "headline_im2col", "bert", "wave1024",
           "wave1024_fused", "wave128", "attn")
 
 
-def _conv_winner(default: str = "direct") -> str:
-    """Read the conv shootout's full-model winner from the results
-    JSONL so downstream 1024-client stages run the faster lowering."""
+def _conv_winner(default: str = "direct") -> tuple:
+    """Read the conv shootout's full-model winner (lowering impl AND
+    local batch size) from the results JSONL so downstream 1024-client
+    stages run the fastest measured configuration."""
     try:
         with open(OUT_JSONL) as f:
             lines = f.readlines()
     except OSError:
-        return default
+        return default, 32
     for line in reversed(lines):
         try:
             rec = json.loads(line)
         except ValueError:
             continue
-        if rec.get("stage") == "conv" and rec.get("full_model"):
+        # only trust TPU-platform records: a smoke/CPU plumbing run's
+        # batch size must never steer the scarce hardware stages
+        if (rec.get("stage") == "conv" and rec.get("full_model")
+                and rec.get("platform") == "tpu"):
             fm = rec["full_model"]
             best = max(
                 (i for i in fm if "rounds_per_sec" in fm[i]),
                 key=lambda i: fm[i]["rounds_per_sec"], default=None)
-            return best or default
-    return default
+            if best is None:
+                return default, 32
+            impl = best.split("_b")[0]  # "im2col_b48" -> "im2col"
+            return impl, int(fm[best].get("batch_size", 32))
+    return default, 32
 
 
 def append_result(rec: dict) -> None:
@@ -538,6 +575,7 @@ def main() -> None:
     ap.add_argument("--child", default=None)
     ap.add_argument("--wave", type=int, default=64)
     ap.add_argument("--conv-impl", default="direct")
+    ap.add_argument("--batch", type=int, default=32)
     args = ap.parse_args()
 
     if args.child:
@@ -546,9 +584,11 @@ def main() -> None:
         elif args.child == "bert":
             print(json.dumps(child_bert()))
         elif args.child == "wave1024":
-            print(json.dumps(child_wave1024(args.wave, args.conv_impl)))
+            print(json.dumps(child_wave1024(args.wave, args.conv_impl,
+                                            args.batch)))
         elif args.child == "wave1024_fused":
-            print(json.dumps(child_wave1024_fused(args.wave, args.conv_impl)))
+            print(json.dumps(child_wave1024_fused(args.wave, args.conv_impl,
+                                                  args.batch)))
         else:
             raise SystemExit(f"unknown child {args.child}")
         return
@@ -570,16 +610,16 @@ def main() -> None:
         elif stage == "bert":
             run_child([py, me, "--child", "bert"], 900, "bert")
         elif stage == "wave1024":
-            impl = _conv_winner()
+            impl, bs = _conv_winner()
             for w in (64, 32):
                 run_child([py, me, "--child", "wave1024", "--wave", str(w),
-                           "--conv-impl", impl],
-                          900, f"wave1024_w{w}_{impl}")
+                           "--conv-impl", impl, "--batch", str(bs)],
+                          900, f"wave1024_w{w}_{impl}_b{bs}")
         elif stage == "wave1024_fused":
-            impl = _conv_winner()
+            impl, bs = _conv_winner()
             run_child([py, me, "--child", "wave1024_fused", "--wave", "64",
-                       "--conv-impl", impl],
-                      1200, f"wave1024_fused_{impl}")
+                       "--conv-impl", impl, "--batch", str(bs)],
+                      1200, f"wave1024_fused_{impl}_b{bs}")
         elif stage == "wave128":
             # refresh the 128-client sweep with the HBM column; no wave
             # 128 (the full-cohort OOM killed the r3 tunnel for hours)
