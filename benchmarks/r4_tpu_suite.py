@@ -16,6 +16,9 @@ hang/crash cannot take out the rest; results append to
 4. ``bert``          — transformer flagship MFU: BERT-base federated
                        round, FLOPs from XLA cost analysis (item 2b;
                        target measured mfu >= 0.2)
+4b. ``llama``        — config-4 flagship: ~0.9B-param decoder, LoRA
+                       adapters-only federated fine-tune, remat on,
+                       tokens/s + MFU from XLA cost analysis
 5. ``wave1024``      — the north-star cohort: 1024 clients in waves of
                        {32, 64} using the conv-shootout winner, rounds/s
                        + per-wave peak HBM (item 4)
@@ -77,6 +80,28 @@ def _peak_hbm_gb(dev, jitted=None, args=None):
     from baton_tpu.utils.profiling import peak_hbm_gb
 
     return peak_hbm_gb(dev, jitted, args)[0]
+
+
+def _timed_rounds(sim, params, data, n_samples, key, iters, **round_kw):
+    """Shared measurement core for the model stages: one compile round
+    (timed separately), then ``iters`` steady-state rounds. Returns
+    (final_params, seconds_per_round, compile_s)."""
+    import jax
+
+    t_c = time.perf_counter()
+    res = sim.run_round(params, data, n_samples, key,
+                        collect_client_losses=False, **round_kw)
+    float(res.loss_history[-1])
+    compile_s = time.perf_counter() - t_c
+    p = res.params
+    t0 = time.perf_counter()
+    for i in range(iters):
+        res = sim.run_round(p, data, n_samples, jax.random.fold_in(key, i),
+                            collect_client_losses=False, **round_kw)
+        p = res.params
+    float(res.loss_history[-1])
+    dt = (time.perf_counter() - t0) / iters
+    return p, dt, compile_s
 
 
 def _cost_flops(jitted, *args):
@@ -209,20 +234,8 @@ def child_conv() -> dict:
         for bs in batch_sizes:
             data, n_samples = stage(bs)  # capacity rounds to the batch
             sim = FedSim(model, batch_size=bs, learning_rate=0.05)
-            t_c = time.perf_counter()
-            res = sim.run_round(params, data, n_samples, key,
-                                collect_client_losses=False)
-            float(res.loss_history[-1])
-            compile_s = time.perf_counter() - t_c
-            iters, p = (2 if SMOKE else 12), res.params
-            t0 = time.perf_counter()
-            for i in range(iters):
-                res = sim.run_round(p, data, n_samples,
-                                    jax.random.fold_in(key, i),
-                                    collect_client_losses=False)
-                p = res.params
-            float(res.loss_history[-1])
-            dt = (time.perf_counter() - t0) / iters
+            _, dt, compile_s = _timed_rounds(sim, params, data, n_samples,
+                                             key, 2 if SMOKE else 12)
             sps = C * spc / dt
             tag = impl if bs == 32 or SMOKE else f"{impl}_b{bs}"
             out["full_model"][tag] = {
@@ -272,32 +285,24 @@ def child_bert() -> dict:
 
     sim = FedSim(model, batch_size=B, learning_rate=0.01)
     key = jax.random.key(1)
+    t_child = time.perf_counter()
+    p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key,
+                                     2 if SMOKE else 10)
 
-    t_c = time.perf_counter()
-    res = sim.run_round(params, data, n_samples, key,
-                        collect_client_losses=False)
-    float(res.loss_history[-1])
-    compile_s = time.perf_counter() - t_c
-
-    iters, p = 10, res.params
-    t0 = time.perf_counter()
-    for i in range(iters):
-        res = sim.run_round(p, data, n_samples, jax.random.fold_in(key, i),
-                            collect_client_losses=False)
-        p = res.params
-    float(res.loss_history[-1])
-    dt = (time.perf_counter() - t0) / iters
-
-    # XLA's own FLOP count for the wave kernel — measured, not analytic
+    # XLA's own FLOP count for the wave kernel — measured, not analytic.
+    # Budget-gated (900 s child timeout, 300 s reserve): the probe
+    # compiles a fresh program and must not starve the measured result.
     rngs = jax.random.split(key, C)
-    jitted = None
-    try:
-        jitted = jax.jit(
-            lambda pr, d, n, r: sim._wave_sums_raw(pr, None, d, n, r, 1))
-        xla_flops = _cost_flops(jitted, p, data, n_samples, rngs)
-    except Exception:
-        xla_flops = None
-    hbm_args = (p, data, n_samples, rngs)
+    jitted = xla_flops = None
+    hbm_args = None
+    if time.perf_counter() - t_child < 600.0:
+        try:
+            jitted = jax.jit(
+                lambda pr, d, n, r: sim._wave_sums_raw(pr, None, d, n, r, 1))
+            xla_flops = _cost_flops(jitted, p, data, n_samples, rngs)
+            hbm_args = (p, data, n_samples, rngs)
+        except Exception:
+            jitted = None
 
     tokens_per_round = C * B * L
     analytic_flops = 6.0 * n_params * tokens_per_round
@@ -317,6 +322,98 @@ def child_bert() -> dict:
         "mfu_analytic": round(analytic_flops / dt / V5E_PEAK_BF16, 4),
         "compile_s": round(compile_s, 1),
         "peak_hbm_gb": _peak_hbm_gb(dev, jitted, hbm_args),
+    }
+
+
+# ======================================================================
+# stage: llama — the config-4 flagship: LoRA federated fine-tune of a
+# ~0.9B-param decoder (the largest that fits one v5e with its fp32 base
+# replicated once), adapters-only training, remat seams on
+def child_llama() -> dict:
+    jax = _jax_setup()
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    from baton_tpu.models.llama import (
+        LlamaConfig,
+        llama_lm_model,
+        llama_lora_target,
+    )
+    from baton_tpu.models.lora import lora_trainable, lora_wrap
+    from baton_tpu.ops.padding import stack_client_datasets
+    from baton_tpu.parallel.engine import FedSim
+
+    if SMOKE:
+        C, B, L = 2, 2, 16
+        cfg = LlamaConfig.tiny(max_len=L)
+    else:
+        C, B, L = 4, 4, 512
+        cfg = LlamaConfig(vocab_size=32000, max_len=L, d_model=2048,
+                          n_layers=16, n_heads=16, n_kv_heads=8,
+                          d_ff=5632, rope_theta=500000.0)
+    model = lora_wrap(
+        llama_lm_model(cfg, compute_dtype=jnp.bfloat16, remat=True,
+                       name="llama0.9b_bf16"),
+        rank=16, target=llama_lora_target)
+    params = model.init(jax.random.key(0))
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+
+    rng = np.random.default_rng(0)
+    datasets = [{
+        "x": rng.integers(0, cfg.vocab_size, size=(B, L)).astype(np.int32),
+        "y": rng.integers(0, cfg.vocab_size, size=(B, L)).astype(np.int32),
+    } for _ in range(C)]
+    data, n_samples = stack_client_datasets(datasets, batch_size=B)
+    data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    sim = FedSim(model, batch_size=B, learning_rate=1e-3,
+                 trainable=lora_trainable)
+    key = jax.random.key(1)
+    t_child = time.perf_counter()
+    p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key,
+                                     2 if SMOKE else 6)
+
+    # probes below each COMPILE a fresh program; gate on the child's
+    # 1200 s budget so a slow tunnel compile can't discard the
+    # already-measured rounds (300 s reserve)
+    jitted = xla_flops = None
+    if time.perf_counter() - t_child < 900.0 - compile_s:
+        tr, fz = sim._split(p)
+        rngs = jax.random.split(key, C)
+        try:
+            jitted = jax.jit(
+                lambda a, b, d, n, r: sim._wave_sums_raw(a, b, d, n, r, 1))
+            xla_flops = _cost_flops(jitted, tr, fz, data, n_samples, rngs)
+        except Exception:
+            jitted = None
+
+    tokens = C * B * L
+    # Model-FLOPs for an adapters-only LoRA step: fwd 2PN + activation
+    # backprop through the frozen base 2PN, NO base weight gradients
+    # => ~4PN (6PN would overstate by ~1.5x). XLA's count additionally
+    # includes the remat forward recompute, so it is HFU, not MFU —
+    # reported under its own key, never blended into mfu.
+    analytic_flops = 4.0 * n_params * tokens
+    return {
+        "stage": "llama", "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "model": "llama0.9b_lora_bf16_remat", "n_params": n_params,
+        "clients": C, "batch": B, "seq_len": L, "lora_rank": 16,
+        "rounds_per_sec": round(1 / dt, 3),
+        "tokens_per_sec_per_chip": round(tokens / dt, 1),
+        "flops_per_round_xla_hw": xla_flops,
+        "flops_per_round_model": analytic_flops,
+        "mfu": round(analytic_flops / dt / V5E_PEAK_BF16, 4),
+        "hfu_xla": (round(xla_flops / dt / V5E_PEAK_BF16, 4)
+                    if xla_flops else None),
+        "compile_s": round(compile_s, 1),
+        "peak_hbm_gb": _peak_hbm_gb(
+            dev, jitted, (tr, fz, data, n_samples, rngs)
+            if jitted is not None else None),
+        "remat": True,
     }
 
 
@@ -359,21 +456,8 @@ def child_wave1024(wave_size: int, conv_impl: str = "direct",
     # original headline config)
     sim = FedSim(model, batch_size=bs, learning_rate=0.05)
     key = jax.random.key(1)
-
-    t_c = time.perf_counter()
-    res = sim.run_round(params, data, n_samples, key,
-                        wave_size=wave_size, collect_client_losses=False)
-    float(res.loss_history[-1])
-    compile_s = time.perf_counter() - t_c
-
-    iters, p = 3, res.params
-    t0 = time.perf_counter()
-    for i in range(iters):
-        res = sim.run_round(p, data, n_samples, jax.random.fold_in(key, i),
-                            wave_size=wave_size, collect_client_losses=False)
-        p = res.params
-    float(res.loss_history[-1])
-    dt = (time.perf_counter() - t0) / iters
+    p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key, 3,
+                                     wave_size=wave_size)
     sps = C * S / dt
 
     # per-wave static HBM plan (the allocator peak is invisible through
@@ -484,8 +568,8 @@ def child_wave1024_fused(wave_size: int, conv_impl: str = "direct",
 
 
 # ======================================================================
-STAGES = ("headline", "conv", "headline_im2col", "bert", "wave1024",
-          "wave1024_fused", "wave128", "attn")
+STAGES = ("headline", "conv", "headline_im2col", "bert", "llama",
+          "wave1024", "wave1024_fused", "wave128", "attn")
 
 
 def _conv_winner(default: str = "direct") -> tuple:
@@ -583,6 +667,8 @@ def main() -> None:
             print(json.dumps(child_conv()))
         elif args.child == "bert":
             print(json.dumps(child_bert()))
+        elif args.child == "llama":
+            print(json.dumps(child_llama()))
         elif args.child == "wave1024":
             print(json.dumps(child_wave1024(args.wave, args.conv_impl,
                                             args.batch)))
@@ -609,6 +695,8 @@ def main() -> None:
                        "BATON_BENCH_CONV_IMPL": "im2col"})
         elif stage == "bert":
             run_child([py, me, "--child", "bert"], 900, "bert")
+        elif stage == "llama":
+            run_child([py, me, "--child", "llama"], 1200, "llama")
         elif stage == "wave1024":
             impl, bs = _conv_winner()
             for w in (64, 32):
