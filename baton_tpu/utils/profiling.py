@@ -53,6 +53,39 @@ def timed(fn: Callable, *args: Any, **kwargs: Any) -> Tuple[Any, float]:
     jax.block_until_ready(out)
     return out, time.perf_counter() - t0
 
+def _plan_gb_of(jitted, args) -> Optional[float]:
+    """XLA's static memory plan for ``jitted(*args)`` in GiB: arguments
+    + outputs + temps minus aliased buffers — the single byte-accounting
+    rule every helper below shares. Compiles (never executes)."""
+    try:
+        ma = jitted.lower(*args).compile().memory_analysis()
+        tot = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+               + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+        # 6 decimals: tiny test programs must not round to a deceptive
+        # 0.0 GiB (real wave kernels are >= MBs)
+        return round(tot / 2**30, 6) if tot > 0 else None
+    except Exception:
+        return None
+
+
+def _lower_wave_kernel(sim, params, data, n_samples, key,
+                       wave_size: Optional[int] = None, n_epochs: int = 1):
+    """(jitted, args) for ONE wave of ``sim``'s round, honoring a
+    trainable/frozen partition — the program whose memory plan stands in
+    for the round's footprint."""
+    import jax
+    import jax.numpy as jnp
+
+    tr, fz = sim._split(params)
+    n_samples = jnp.asarray(n_samples)
+    w = wave_size or int(n_samples.shape[0])
+    d0 = jax.tree_util.tree_map(lambda a: a[:w], data)
+    n0 = n_samples[:w]
+    r0 = jax.random.split(key, w)
+    jitted = jax.jit(lambda a, b, d, n, r: sim._wave_sums_raw(
+        a, b, d, n, r, n_epochs))
+    return jitted, (tr, fz, d0, n0, r0)
+
 
 def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None
                 ) -> Tuple[Optional[float], Optional[str]]:
@@ -61,35 +94,63 @@ def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None
     Prefers the runtime allocator's ``peak_bytes_in_use``; when the
     runtime surfaces no allocator stats (the tunneled axon TPU reports
     none — observed every round-3 run), falls back to XLA's static
-    memory plan for ``jitted(*args)``: arguments + outputs + temps minus
-    aliased buffers — the compiler's own HBM budget for the program, a
-    lower bound on (and in practice ~equal to) the allocator peak.
-    Returns ``(GiB, source)`` with source ``"allocator"`` /
-    ``"xla_memory_analysis"``, or ``(None, None)`` when neither is
-    available. Note the fallback COMPILES ``jitted`` if it isn't
+    memory plan for ``jitted(*args)``. Returns ``(GiB, source)`` with
+    source ``"allocator"`` / ``"xla_memory_analysis"``, or
+    ``(None, None)``. The fallback COMPILES ``jitted`` if it isn't
     already cached — callers on a wall-clock budget must gate on it.
     """
     try:
         stats = device.memory_stats() or {}
         peak = stats.get("peak_bytes_in_use", 0)
         if peak:
-            # 6 decimals on both branches: a sub-MB peak must not round
-            # to a deceptive 0.0 GiB
             return round(peak / 2**30, 6), "allocator"
     except Exception:
         pass
     if jitted is not None and args is not None:
-        try:
-            ma = jitted.lower(*args).compile().memory_analysis()
-            tot = (ma.argument_size_in_bytes + ma.output_size_in_bytes
-                   + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
-            if tot > 0:
-                # 6 decimals: tiny test programs must not round to a
-                # deceptive 0.0 GiB (real wave kernels are >= MBs)
-                return round(tot / 2**30, 6), "xla_memory_analysis"
-        except Exception:
-            pass
+        gb = _plan_gb_of(jitted, args)
+        if gb is not None:
+            return gb, "xla_memory_analysis"
     return None, None
+
+
+# Per-generation execution budgets for the OOM guard: HBM capacity minus
+# runtime/framework headroom. Matched by device_kind prefix; the v5e
+# default covers the unknown case (most conservative of the fleet).
+HBM_BUDGET_GB = {
+    "TPU v4": 29.0,       # 32 GB
+    "TPU v5 lite": 13.5,  # v5e, 16 GB
+    "TPU v5e": 13.5,
+    "TPU v5": 90.0,       # v5p, 95 GB
+    "TPU v5p": 90.0,
+    "TPU v6 lite": 28.0,  # v6e, 32 GB
+    "TPU v6e": 28.0,
+}
+DEFAULT_HBM_BUDGET_GB = 13.5
+
+
+def hbm_budget_gb(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for prefix, budget in HBM_BUDGET_GB.items():
+        if kind.startswith(prefix):
+            return budget
+    return DEFAULT_HBM_BUDGET_GB
+
+
+def fedsim_wave_plan_gb(sim, params, data, n_samples, key,
+                        wave_size: Optional[int] = None,
+                        n_epochs: int = 1) -> Optional[float]:
+    """XLA's static HBM plan (GiB) for one wave's kernel, compiled
+    WITHOUT executing. The OOM guard: an out-of-memory execution on the
+    tunneled chip causes a multi-hour outage (r3 postmortem), so
+    benchmark stages check the compiler's own budget first and skip —
+    recording the plan — instead of running a program that cannot fit.
+    Returns None when analysis is unavailable (CPU/smoke — proceed)."""
+    try:
+        jitted, args = _lower_wave_kernel(sim, params, data, n_samples,
+                                          key, wave_size, n_epochs)
+        return _plan_gb_of(jitted, args)
+    except Exception:
+        return None
 
 
 def fedsim_wave_hbm(device, sim, params, data, n_samples, key,
@@ -98,32 +159,21 @@ def fedsim_wave_hbm(device, sim, params, data, n_samples, key,
                     ) -> Tuple[Optional[float], Optional[str]]:
     """Peak-HBM estimate for one wave of a :class:`FedSim` round.
 
-    Allocator stats when available (cheap); otherwise lowers ONE wave's
-    kernel (``_wave_sums_raw`` with no frozen partition — callers with a
-    LoRA split need their own program) for XLA's static plan. Lowering
-    compiles a fresh program, so when ``remaining_s`` is given the
-    fallback is skipped below a 60 s floor — a slow tunnel compile must
-    never turn an already-measured benchmark into a timeout. This is the
-    single shared implementation for bench.py / wave_sweep.py /
-    r4_tpu_suite.py (it was once four copies).
+    Allocator stats when available (cheap); otherwise XLA's static plan
+    for one wave's kernel. Lowering compiles a fresh program, so when
+    ``remaining_s`` is given the fallback is skipped below a 60 s floor
+    — a slow tunnel compile must never turn an already-measured
+    benchmark into a timeout. Single shared implementation for
+    bench.py / wave_sweep.py / r4_tpu_suite.py.
     """
-    import jax
-    import jax.numpy as jnp
-
     gb, src = peak_hbm_gb(device)
     if gb is not None:
         return gb, src
     if remaining_s is not None and remaining_s < 60.0:
         return None, None
     try:
-        n_samples = jnp.asarray(n_samples)
-        if wave_size is None:
-            wave_size = int(n_samples.shape[0])
-        d0 = jax.tree_util.tree_map(lambda a: a[:wave_size], data)
-        n0 = n_samples[:wave_size]
-        r0 = jax.random.split(key, wave_size)
-        jitted = jax.jit(lambda pr, d, n, r: sim._wave_sums_raw(
-            pr, None, d, n, r, n_epochs))
-        return peak_hbm_gb(device, jitted, (params, d0, n0, r0))
+        jitted, args = _lower_wave_kernel(sim, params, data, n_samples,
+                                          key, wave_size, n_epochs)
+        return peak_hbm_gb(device, jitted, args)
     except Exception:
         return None, None

@@ -291,10 +291,44 @@ def main() -> None:
     sim = FedSim(model, batch_size=BATCH_SIZE, learning_rate=0.05)
     key = jax.random.key(1)
 
+    # OOM guard (non-default conv lowerings only — the direct full-wave
+    # config is proven on hardware): an OOM puts the tunneled chip into
+    # a multi-hour outage, so check XLA's static HBM plan first and
+    # halve the wave until the plan fits rather than risk the execution.
+    wave_size = None
+    if not degraded and conv_impl != "direct":
+        from baton_tpu.utils.profiling import (
+            fedsim_wave_plan_gb,
+            hbm_budget_gb,
+        )
+
+        budget = hbm_budget_gb(devs[0])
+        w = n_clients
+        plan = fedsim_wave_plan_gb(sim, params, data, n_samples, key,
+                                   n_epochs=N_EPOCHS)
+        while plan is not None and plan > budget and w > 4:
+            w //= 2
+            plan = fedsim_wave_plan_gb(sim, params, data, n_samples, key,
+                                       wave_size=w, n_epochs=N_EPOCHS)
+            if plan is not None:
+                log(f"plan over {budget:.1f} GiB budget -> wave {w} "
+                    f"(plan {plan:.1f} GiB)")
+            else:
+                log(f"wave {w}: plan unavailable")
+        if plan is not None and plan > budget:
+            raise RuntimeError(
+                f"no wave size down to {w} fits the {budget:.1f} GiB "
+                f"budget (smallest plan {plan:.1f} GiB) — refusing to "
+                "risk an OOM on the tunneled chip"
+            )
+        if w != n_clients:
+            wave_size = w
+            log(f"running in waves of {wave_size}")
+
     # --- compile (reported separately, never inside the timed window) ---
     t_c = time.perf_counter()
     res = sim.run_round(params, data, n_samples, key, n_epochs=N_EPOCHS,
-                        collect_client_losses=False)
+                        wave_size=wave_size, collect_client_losses=False)
     first_loss = float(res.loss_history[-1])  # host fetch = hard sync point
     compile_s = time.perf_counter() - t_c
     log(f"round program compiled+ran in {compile_s:.1f}s "
@@ -305,7 +339,7 @@ def main() -> None:
     t_e = time.perf_counter()
     res = sim.run_round(res.params, data, n_samples,
                         jax.random.fold_in(key, 1), n_epochs=N_EPOCHS,
-                        collect_client_losses=False)
+                        wave_size=wave_size, collect_client_losses=False)
     float(res.loss_history[-1])
     est = time.perf_counter() - t_e
     timed_rounds = int(max(3, min(50, (remaining() - 30.0) / max(est, 1e-3))))
@@ -315,7 +349,8 @@ def main() -> None:
     t0 = time.perf_counter()
     for i in range(timed_rounds):
         res = sim.run_round(p, data, n_samples, jax.random.fold_in(key, 2 + i),
-                            n_epochs=N_EPOCHS, collect_client_losses=False)
+                            n_epochs=N_EPOCHS, wave_size=wave_size,
+                            collect_client_losses=False)
         p = res.params
     final_loss = float(res.loss_history[-1])  # forces the whole chain
     dt = time.perf_counter() - t0
@@ -333,14 +368,16 @@ def main() -> None:
             t_fc = time.perf_counter()
             p2, hist = sim.run_rounds_fused(
                 p, data, n_samples, jax.random.fold_in(key, 999),
-                n_rounds=k_f, n_epochs=N_EPOCHS, donate_buffers=True)
+                n_rounds=k_f, n_epochs=N_EPOCHS, wave_size=wave_size,
+                donate_buffers=True)
             fused_compile_s = time.perf_counter() - t_fc
             log(f"fused {k_f}-round program compiled+ran in {fused_compile_s:.1f}s")
             if remaining() > 1.5 * fused_compile_s * 0.2 + 10:
                 t_f = time.perf_counter()
                 p2, hist = sim.run_rounds_fused(
                     p2, data, n_samples, jax.random.fold_in(key, 1000),
-                    n_rounds=k_f, n_epochs=N_EPOCHS, donate_buffers=True)
+                    n_rounds=k_f, n_epochs=N_EPOCHS, wave_size=wave_size,
+                    donate_buffers=True)
                 fused_dt = time.perf_counter() - t_f
                 fused_rps = k_f / fused_dt
                 log(f"fused steady state: {k_f} rounds in {fused_dt:.2f}s "
@@ -419,7 +456,7 @@ def main() -> None:
 
     peak_hbm_gb, peak_hbm_source = fedsim_wave_hbm(
         devs[0], sim, p, data, n_samples, key, n_epochs=N_EPOCHS,
-        remaining_s=remaining())
+        wave_size=wave_size, remaining_s=remaining())
 
     # Honest metric naming (VERDICT r2 weak item 2): a degraded run measures
     # a DIFFERENT experiment (toy CNN, fewer clients, host CPU) — its JSON
@@ -446,6 +483,10 @@ def main() -> None:
         "clients": n_clients,
         "samples_per_client": samples_per_client,
         "batch_size": BATCH_SIZE,
+        # None = the whole cohort in one wave; set when the OOM guard
+        # degraded a non-default lowering to waves (a DIFFERENT program
+        # from the full-wave headline config — must be distinguishable)
+        "wave_size": wave_size,
         "compile_s": round(compile_s, 1),
         "samples_per_sec_per_chip": round(samples_per_sec, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,

@@ -234,10 +234,23 @@ def child_conv() -> dict:
         for bs in batch_sizes:
             data, n_samples = stage(bs)  # capacity rounds to the batch
             sim = FedSim(model, batch_size=bs, learning_rate=0.05)
+            tag = impl if bs == 32 or SMOKE else f"{impl}_b{bs}"
+            # OOM guard: im2col's kh*kw patch blowup can exceed HBM at
+            # the full 32-client wave — check the compiler's plan first
+            from baton_tpu.utils.profiling import (
+                fedsim_wave_plan_gb, hbm_budget_gb)
+
+            plan_gb = fedsim_wave_plan_gb(sim, params, data, n_samples, key)
+            if plan_gb is not None and plan_gb > hbm_budget_gb(dev):
+                out["full_model"][tag] = {
+                    "batch_size": bs,
+                    "skipped": "static HBM plan exceeds budget",
+                    "plan_gb": round(plan_gb, 2),
+                }
+                continue
             _, dt, compile_s = _timed_rounds(sim, params, data, n_samples,
                                              key, 2 if SMOKE else 12)
             sps = C * spc / dt
-            tag = impl if bs == 32 or SMOKE else f"{impl}_b{bs}"
             out["full_model"][tag] = {
                 "batch_size": bs,
                 "rounds_per_sec": round(1 / dt, 3),
@@ -245,6 +258,7 @@ def child_conv() -> dict:
                 "mfu_analytic": round(
                     sps * RESNET_TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16, 4),
                 "compile_s": round(compile_s, 1),
+                "plan_gb": round(plan_gb, 2) if plan_gb else None,
             }
     out["peak_hbm_gb"] = _peak_hbm_gb(dev)
     return out
@@ -456,6 +470,18 @@ def child_wave1024(wave_size: int, conv_impl: str = "direct",
     # original headline config)
     sim = FedSim(model, batch_size=bs, learning_rate=0.05)
     key = jax.random.key(1)
+    from baton_tpu.utils.profiling import fedsim_wave_plan_gb, hbm_budget_gb
+
+    plan_gb = fedsim_wave_plan_gb(sim, params, data, n_samples, key,
+                                  wave_size=wave_size)
+    if plan_gb is not None and plan_gb > hbm_budget_gb(dev):
+        return {
+            "stage": "wave1024", "platform": dev.platform,
+            "model": f"resnet18_bf16_{conv_impl}", "clients": C,
+            "wave_size": wave_size, "batch_size": bs,
+            "skipped": "static HBM plan exceeds budget",
+            "plan_gb": round(plan_gb, 2),
+        }
     p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key, 3,
                                      wave_size=wave_size)
     sps = C * S / dt
@@ -527,6 +553,20 @@ def child_wave1024_fused(wave_size: int, conv_impl: str = "direct",
     key = jax.random.key(1)
     n_rounds = 2 if SMOKE else 3
 
+    # guard with one wave's plan + margin (the fused scan adds only the
+    # params/opt/accumulator carries, ~3 model-sized buffers)
+    from baton_tpu.utils.profiling import fedsim_wave_plan_gb, hbm_budget_gb
+
+    plan_gb = fedsim_wave_plan_gb(sim, params, data, n_samples, key,
+                                  wave_size=wave_size)
+    if plan_gb is not None and plan_gb + 0.5 > hbm_budget_gb(dev):
+        return {
+            "stage": "wave1024_fused", "platform": dev.platform,
+            "model": f"resnet18_bf16_{conv_impl}", "clients": C,
+            "wave_size": wave_size, "batch_size": bs,
+            "skipped": "static HBM plan exceeds budget",
+            "plan_gb": round(plan_gb, 2),
+        }
     t_c = time.perf_counter()
     p, hist = sim.run_rounds_fused(params, data, n_samples, key,
                                    n_rounds=n_rounds, wave_size=wave_size,
@@ -699,7 +739,12 @@ def main() -> None:
             run_child([py, me, "--child", "llama"], 1200, "llama")
         elif stage == "wave1024":
             impl, bs = _conv_winner()
-            for w in (64, 32):
+            # im2col's patch blowup may exceed HBM at large waves: the
+            # children static-plan-guard each setting, and the ladder
+            # includes 16 so SOME 1024-client point lands even if 64/32
+            # only record skips
+            waves = (64, 32) if impl == "direct" else (64, 32, 16)
+            for w in waves:
                 run_child([py, me, "--child", "wave1024", "--wave", str(w),
                            "--conv-impl", impl, "--batch", str(bs)],
                           900, f"wave1024_w{w}_{impl}_b{bs}")
