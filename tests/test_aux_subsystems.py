@@ -330,3 +330,54 @@ def test_peak_hbm_estimation_fallback():
         assert gb2 is None and src2 is None
     else:
         assert gb2 == alloc
+
+
+def test_conv_winner_ignores_smoke_and_failed_records(tmp_path):
+    """The r4 suite's winner selection steers scarce TPU stages: CPU
+    smoke records and failed stages must never pick the config."""
+    import importlib.util
+    import json
+    import pathlib
+
+    suite_path = (pathlib.Path(__file__).resolve().parent.parent
+                  / "benchmarks" / "r4_tpu_suite.py")
+    spec = importlib.util.spec_from_file_location("r4_suite", suite_path)
+    suite = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(suite)
+
+    out = tmp_path / "results.jsonl"
+    suite.OUT_JSONL = str(out)
+    # no file yet -> defaults
+    assert suite._conv_winner() == ("direct", 32)
+    records = [
+        {"stage": "conv", "platform": "cpu",  # smoke run: must be ignored
+         "full_model": {"im2col": {"batch_size": 8,
+                                   "rounds_per_sec": 99.0}}},
+        {"stage": "conv", "failed": "timeout"},
+    ]
+    out.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    assert suite._conv_winner() == ("direct", 32)
+    # a TPU record wins, tag suffix parsed back to the impl name
+    records.append(
+        {"stage": "conv", "platform": "tpu",
+         "full_model": {
+             "direct": {"batch_size": 32, "rounds_per_sec": 3.0},
+             "im2col_b48": {"batch_size": 48, "rounds_per_sec": 9.0},
+             "direct_b48": {"batch_size": 48,
+                            "skipped": "static HBM plan exceeds budget"},
+         }})
+    out.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    assert suite._conv_winner() == ("im2col", 48)
+
+
+def test_hbm_budget_device_mapping():
+    from baton_tpu.utils.profiling import hbm_budget_gb
+
+    class D:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    assert hbm_budget_gb(D("TPU v5 lite")) == 13.5
+    assert hbm_budget_gb(D("TPU v4")) == 29.0
+    assert hbm_budget_gb(D("TPU v5p")) == 90.0
+    assert hbm_budget_gb(D("weird accelerator")) == 13.5  # conservative
