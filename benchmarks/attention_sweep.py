@@ -21,6 +21,13 @@ import os
 import time
 
 import jax
+
+# the JAX_PLATFORMS env var does not reliably override this container's
+# axon plugin (a cpu-intended run can hang dialing a dark tunnel at
+# first backend touch); only jax.config pins deterministically
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 from baton_tpu.models.transformer import dot_product_attention

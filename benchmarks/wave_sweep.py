@@ -40,6 +40,10 @@ def run_one(wave_size: int) -> dict:
 
     import jax
 
+    # env-var platform overrides are unreliable against the axon plugin;
+    # honor an explicit cpu request through jax.config (deterministic)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     jax.config.update(
         "jax_compilation_cache_dir",
         os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/baton_tpu_jax_cache"),
