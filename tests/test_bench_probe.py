@@ -1,0 +1,91 @@
+"""bench.py's two-tier backend probe (VERDICT r3 item 1a): the budget
+guard, tier schedule, and fallback decisions are pure logic around
+subprocess calls — pinned here with a stubbed subprocess so the driver's
+one real run has no untested branches."""
+
+import importlib.util
+import pathlib
+import subprocess
+import sys
+import types
+
+import pytest
+
+BENCH = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+
+
+@pytest.fixture
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # freeze the budget clock: a fresh T0 means remaining() ~= BUDGET_S
+    mod.T0 = mod.time.perf_counter()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    return mod
+
+
+def _ok_result():
+    r = types.SimpleNamespace()
+    r.returncode = 0
+    r.stdout = "tpu 1 TPU v5 lite\n"
+    r.stderr = ""
+    return r
+
+
+def test_probe_live_backend_first_tier(bench, monkeypatch):
+    calls = []
+
+    def fake_run(args, capture_output, text, timeout):
+        calls.append(timeout)
+        return _ok_result()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    plat, report = bench.probe_backend()
+    assert plat == ""  # leave the live default
+    assert calls == [30.0]  # fast tier sufficed
+    assert report["attempts"][0]["stdout"].startswith("tpu")
+
+
+def test_probe_dead_tunnel_uses_both_tiers_then_cpu(bench, monkeypatch):
+    calls = []
+
+    def fake_run(args, capture_output, text, timeout):
+        calls.append(timeout)
+        raise subprocess.TimeoutExpired(args, timeout, output=b"",
+                                        stderr=b"dial tcp: timeout")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    plat, report = bench.probe_backend()
+    assert plat == "cpu"
+    assert calls == [30.0, 150.0]  # fast tier, then the long retry
+    assert all(a.get("timeout") for a in report["attempts"])
+    assert "dial tcp" in report["attempts"][0]["stderr_tail"]
+
+
+def test_probe_skips_tiers_the_budget_cannot_absorb(bench, monkeypatch):
+    # burn the budget down so only the fast tier fits (the r3 failure
+    # was the inverse: the long tier ran first and ate the retry)
+    bench.T0 = bench.time.perf_counter() - (bench.BUDGET_S - 170.0)
+    calls = []
+
+    def fake_run(args, capture_output, text, timeout):
+        calls.append(timeout)
+        raise subprocess.TimeoutExpired(args, timeout)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    plat, report = bench.probe_backend()
+    assert plat == "cpu"
+    assert calls == [30.0]  # 150s tier skipped: 170s left < 150+120
+    assert any("skipped" in a for a in report["attempts"])
+
+
+def test_probe_honors_explicit_cpu_override(bench, monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    called = []
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: called.append(1))
+    plat, report = bench.probe_backend()
+    assert plat == "cpu" and not called
