@@ -75,6 +75,37 @@ def log(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
+# Error signatures of a FLAKY-but-alive tunnel, each observed live on the
+# round-4 chip window: the axon proxy dropped a response body mid-compile
+# and its compile helper 500'd once — and the very same program compiled
+# and ran clean minutes later. Worth one retry; a genuinely dead tunnel is
+# already handled by the subprocess probe, and RESOURCE_EXHAUSTED is
+# deterministic so retrying would only re-OOM the chip.
+TRANSIENT_ERROR_SIGNATURES = (
+    "remote_compile",            # axon proxy compile RPC failures (any)
+    "response body closed",
+    "read body",
+    "socket closed",
+    "connection reset",
+    "unavailable",
+    "deadline exceeded",
+)
+
+
+def is_transient_tunnel_error(e: BaseException) -> bool:
+    # one OOM-detection rule for the whole repo: a proxied compile OOM
+    # can surface as just an allocation breakdown ("Allocation type:
+    # HLO temp") with a remote_compile prefix — it must never be
+    # retried (re-running the program that just OOM'd the tunneled chip
+    # is the multi-hour-outage scenario)
+    from baton_tpu.utils.profiling import is_oom_error
+
+    if is_oom_error(e):
+        return False
+    msg = str(e).lower()
+    return any(s in msg for s in TRANSIENT_ERROR_SIGNATURES)
+
+
 def remaining() -> float:
     return BUDGET_S - (time.perf_counter() - T0)
 
@@ -534,10 +565,30 @@ if __name__ == "__main__":
         _arm_watchdog()
         main()
     except Exception as e:
+        # One retry on a flaky-tunnel signature (observed r4: the first
+        # live headline attempt died to a dropped response body; BERT
+        # then measured clean on the same tunnel minutes later). The
+        # retry RE-EXECS rather than looping in-process: once a backend
+        # is initialized, jax caches it, so an in-process second attempt
+        # against a tunnel that died between attempts would hang on the
+        # cached dead TPU client instead of taking the CPU-degrade path.
+        # A fresh interpreter re-probes honestly (and the 240 s floor
+        # covers the re-probe tiers); the persistent compilation cache
+        # keeps the re-run cheap. BATON_BENCH_RETRY caps it at one.
+        if (os.environ.get("BATON_BENCH_RETRY") != "1"
+                and is_transient_tunnel_error(e) and remaining() > 240.0):
+            log(f"transient tunnel error ({type(e).__name__}: {e}); "
+                f"re-execing once with {remaining():.0f}s left")
+            time.sleep(10.0)
+            os.environ["BATON_BENCH_RETRY"] = "1"
+            os.environ["BATON_BENCH_BUDGET_S"] = f"{remaining():.0f}"
+            sys.stderr.flush()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
         log(f"FATAL {type(e).__name__}: {e}")
         print(json.dumps({
-            # distinct metric name: an errored run measured nothing and must
-            # not parse as the headline number (VERDICT r2 weak item 2)
+            # distinct metric name: an errored run measured nothing and
+            # must not parse as the headline number (VERDICT r2 weak
+            # item 2)
             "metric": "fedavg_rounds_per_sec_bench_error",
             "value": 0.0,
             "unit": "rounds/sec",
@@ -545,5 +596,6 @@ if __name__ == "__main__":
             "unmeasured_metric":
                 "fedavg_rounds_per_sec_resnet18_cifar10_32clients_1chip",
             "error": f"{type(e).__name__}: {e}",
+            "retried": os.environ.get("BATON_BENCH_RETRY") == "1",
         }))
         sys.exit(0)

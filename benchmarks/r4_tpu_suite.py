@@ -251,13 +251,30 @@ def child_conv() -> dict:
             plan_gb = fedsim_wave_plan_gb(sim, params, data, n_samples, key)
             if plan_gb is not None and plan_gb > hbm_budget_gb(dev):
                 out["full_model"][tag] = {
-                    "batch_size": bs,
-                    "skipped": "static HBM plan exceeds budget",
-                    "plan_gb": round(plan_gb, 2),
+                    "batch_size": bs, **_plan_skip_fields(plan_gb),
                 }
                 continue
-            _, dt, compile_s = _timed_rounds(sim, params, data, n_samples,
-                                             key, 2 if SMOKE else 12)
+            # fault isolation: a transport flake on one config must not
+            # take out the remaining configs — this child crashed
+            # wholesale on exactly that during round 4's first live
+            # window. An OOM is different: the tunneled chip can stall
+            # indefinitely mid-compile after one (r3 postmortem), so
+            # compiling yet another config would only burn the child's
+            # timeout — abort and return the partial record instead.
+            try:
+                _, dt, compile_s = _timed_rounds(
+                    sim, params, data, n_samples, key, 2 if SMOKE else 12)
+            except Exception as e:
+                out["full_model"][tag] = {
+                    "batch_size": bs,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                }
+                from baton_tpu.utils.profiling import is_oom_error
+                if is_oom_error(e):
+                    out["aborted"] = "execution OOM — remaining configs " \
+                                     "skipped to spare the tunnel"
+                    return out
+                continue
             sps = C * spc / dt
             out["full_model"][tag] = {
                 "batch_size": bs,
@@ -487,8 +504,7 @@ def child_wave1024(wave_size: int, conv_impl: str = "direct",
             "stage": "wave1024", "platform": dev.platform,
             "model": f"resnet18_bf16_{conv_impl}", "clients": C,
             "wave_size": wave_size, "batch_size": bs,
-            "skipped": "static HBM plan exceeds budget",
-            "plan_gb": round(plan_gb, 2),
+            **_plan_skip_fields(plan_gb),
         }
     p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key, 3,
                                      wave_size=wave_size)
@@ -572,8 +588,7 @@ def child_wave1024_fused(wave_size: int, conv_impl: str = "direct",
             "stage": "wave1024_fused", "platform": dev.platform,
             "model": f"resnet18_bf16_{conv_impl}", "clients": C,
             "wave_size": wave_size, "batch_size": bs,
-            "skipped": "static HBM plan exceeds budget",
-            "plan_gb": round(plan_gb, 2),
+            **_plan_skip_fields(plan_gb),
         }
     t_c = time.perf_counter()
     p, hist = sim.run_rounds_fused(params, data, n_samples, key,
@@ -618,6 +633,18 @@ def child_wave1024_fused(wave_size: int, conv_impl: str = "direct",
 # ======================================================================
 STAGES = ("headline", "conv", "headline_im2col", "bert", "llama",
           "wave1024", "wave1024_fused", "wave128", "attn")
+
+
+def _plan_skip_fields(plan_gb: float) -> dict:
+    """Skip-record fields for an OOM-guard rejection; owns the
+    ``float("inf")`` sentinel convention (= the compile itself
+    RESOURCE_EXHAUSTed, so no byte count exists)."""
+    oom = plan_gb == float("inf")
+    return {
+        "skipped": ("compile-time RESOURCE_EXHAUSTED" if oom
+                    else "static HBM plan exceeds budget"),
+        "plan_gb": None if oom else round(plan_gb, 2),
+    }
 
 
 def _conv_winner(default: str = "direct") -> tuple:

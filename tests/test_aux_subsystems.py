@@ -381,3 +381,38 @@ def test_hbm_budget_device_mapping():
     assert hbm_budget_gb(D("TPU v4")) == 29.0
     assert hbm_budget_gb(D("TPU v5p")) == 90.0
     assert hbm_budget_gb(D("weird accelerator")) == 13.5  # conservative
+
+
+def test_plan_gb_treats_compile_oom_as_infinite():
+    """A compile-time RESOURCE_EXHAUSTED is XLA *proving* the program
+    exceeds HBM (observed live, r4: the conv-shootout im2col wave).
+    fedsim_wave_plan_gb must report it as over-any-budget, not as
+    missing analysis — the r4 live window lost the whole conv stage to
+    the old None-on-OOM behavior waving the config through."""
+    from baton_tpu.utils import profiling
+
+    oom = RuntimeError(
+        "RESOURCE_EXHAUSTED: XLA:TPU compile permanent error. Ran out of "
+        "memory in memory space hbm; Allocation type: HLO temp")
+    assert profiling.is_oom_error(oom)
+    assert not profiling.is_oom_error(RuntimeError("tracing error"))
+
+    class _Boom:
+        def lower(self, *a):
+            raise oom
+
+    assert profiling._plan_gb_of(_Boom(), ()) == float("inf")
+
+    class _Other:
+        def lower(self, *a):
+            raise RuntimeError("memory_analysis unsupported")
+
+    assert profiling._plan_gb_of(_Other(), ()) is None
+
+    # peak_hbm_gb must never report inf as a measurement
+    class _Dev:
+        def memory_stats(self):
+            return {}
+
+    gb, src = profiling.peak_hbm_gb(_Dev(), _Boom(), ())
+    assert gb is None and src is None

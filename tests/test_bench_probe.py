@@ -89,3 +89,38 @@ def test_probe_honors_explicit_cpu_override(bench, monkeypatch):
                         lambda *a, **k: called.append(1))
     plat, report = bench.probe_backend()
     assert plat == "cpu" and not called
+
+
+def test_transient_tunnel_error_classification(bench):
+    """The one-retry guard (round-4 live window: a dropped response body
+    killed the first headline attempt while the tunnel was demonstrably
+    alive) must retry transport flakes and never retry an OOM."""
+    transient = [
+        RuntimeError("INTERNAL: http://127.0.0.1:8103/remote_compile: "
+                     "read body: response body closed before all bytes "
+                     "were read"),
+        RuntimeError("INTERNAL: http://127.0.0.1:8103/remote_compile: "
+                     "HTTP 500: tpu_compile_helper subprocess exit code 1"),
+        RuntimeError("UNAVAILABLE: Socket closed"),
+    ]
+    for e in transient:
+        assert bench.is_transient_tunnel_error(e), e
+    deterministic = [
+        RuntimeError("RESOURCE_EXHAUSTED: Ran out of memory in memory "
+                     "space hbm"),
+        # an OOM surfaced through the proxy still names the condition
+        RuntimeError("remote_compile: HTTP 500: RESOURCE_EXHAUSTED"),
+        ValueError("shapes do not match"),
+    ]
+    for e in deterministic:
+        assert not bench.is_transient_tunnel_error(e), e
+
+
+def test_transient_classifier_defers_to_shared_oom_rule(bench):
+    """A proxied compile OOM can surface as just the allocation
+    breakdown behind a remote_compile prefix — the retry guard must
+    classify it through profiling.is_oom_error, not a private
+    narrower pattern set."""
+    e = RuntimeError("remote_compile: HTTP 500: compile failed; "
+                     "Allocation type: HLO temp; 19. Size: 256.00M")
+    assert not bench.is_transient_tunnel_error(e)
