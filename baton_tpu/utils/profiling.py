@@ -130,18 +130,31 @@ def peak_hbm_gb(device, jitted=None, args: Optional[Tuple] = None
     return None, None
 
 
-# Per-generation execution budgets for the OOM guard: HBM capacity minus
-# runtime/framework headroom. Matched by device_kind prefix; the v5e
-# default covers the unknown case (most conservative of the fleet).
+# Per-generation PLAN-SPACE budgets for the OOM guard. These are NOT
+# physical capacities: XLA's static memory plan systematically
+# overcounts the executed peak for the wave kernels this guard protects
+# (hardware anchors, v5e 16 GB: the round-3 sweep EXECUTED the wave-64
+# ResNet kernel — whose plan measures 17.42 GiB — at 0.942 rounds/s,
+# while the full-cohort wave-128 kernel, plan ~22 GiB by per-client
+# slope, OOM'd and took the tunnel down for hours). Anchor provenance
+# verified before raising the threshold: `git diff r3..HEAD` over
+# models/resnet.py (direct path: pure rename), parallel/engine.py,
+# core/training.py, ops/{aggregation,padding}.py (all empty) — today's
+# direct wave kernel is HLO-identical to the one r3 executed, and the
+# kernel sees only wave-sized avals so cohort size cannot change its
+# plan. The v5e threshold therefore sits just above the proven-good
+# anchor and far below the proven-bad one; generations without executed
+# anchors keep capacity-minus-headroom estimates.
 HBM_BUDGET_GB = {
-    "TPU v4": 29.0,       # 32 GB
-    "TPU v5 lite": 13.5,  # v5e, 16 GB
-    "TPU v5e": 13.5,
+    "TPU v4": 29.0,       # 32 GB (no anchor; capacity-based)
+    "TPU v5 lite": 17.5,  # v5e, 16 GB (anchored: plan 17.42 ran, ~22 OOM'd)
+    "TPU v5e": 17.5,
     "TPU v5": 90.0,       # v5p, 95 GB
     "TPU v5p": 90.0,
     "TPU v6 lite": 28.0,  # v6e, 32 GB
     "TPU v6e": 28.0,
 }
+# unknown device: the conservative pre-calibration v5e value
 DEFAULT_HBM_BUDGET_GB = 13.5
 
 

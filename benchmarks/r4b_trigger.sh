@@ -1,0 +1,14 @@
+#!/bin/bash
+# Re-armed round-4 trigger (second live window): wait for the tunnel,
+# then run the stages the first window missed, in judge-priority order:
+# the driver-judged headline first, then the plan-overcount probe, then
+# the conv shootout + dependents. Leave running in the background; it
+# exits after one full pass.
+cd /root/repo
+LOG=/tmp/tpu_watch2.log
+bash benchmarks/tpu_watch.sh "$LOG"   # blocks until a probe answers
+echo "[trigger] tunnel alive at $(date -u +%H:%M:%S); running stages" >> "$LOG"
+python benchmarks/r4_tpu_suite.py --stages headline >> /tmp/r4_suite_run2.log 2>&1
+python benchmarks/plan_probe.py >> benchmarks/plan_probe_tpu.jsonl 2>>"$LOG"
+python benchmarks/r4_tpu_suite.py --stages conv,headline_im2col,wave1024,wave1024_fused,wave128,attn >> /tmp/r4_suite_run2.log 2>&1
+echo "[trigger] full pass done at $(date -u +%H:%M:%S)" >> "$LOG"

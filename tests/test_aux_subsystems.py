@@ -377,7 +377,9 @@ def test_hbm_budget_device_mapping():
         def __init__(self, kind):
             self.device_kind = kind
 
-    assert hbm_budget_gb(D("TPU v5 lite")) == 13.5
+    # v5e is plan-space calibrated against executed hardware anchors
+    # (r3 wave-64 plan 17.42 ran; full-cohort ~22 OOM'd)
+    assert hbm_budget_gb(D("TPU v5 lite")) == 17.5
     assert hbm_budget_gb(D("TPU v4")) == 29.0
     assert hbm_budget_gb(D("TPU v5p")) == 90.0
     assert hbm_budget_gb(D("weird accelerator")) == 13.5  # conservative
@@ -416,3 +418,41 @@ def test_plan_gb_treats_compile_oom_as_infinite():
 
     gb, src = profiling.peak_hbm_gb(_Dev(), _Boom(), ())
     assert gb is None and src is None
+
+
+def test_wave_sweep_never_clobbers_recorded_artifact(tmp_path):
+    """An all-failure sweep (tunnel outage) must not overwrite a
+    recorded artifact containing real hardware measurements — observed
+    live in r4, where three timed-out waves erased the r3 numbers."""
+    import importlib.util
+    import json
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "wave_sweep_under_test",
+        pathlib.Path(__file__).resolve().parent.parent
+        / "benchmarks" / "wave_sweep.py")
+    ws = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ws)
+
+    out = tmp_path / "sweep.json"
+    good = [{"wave_size": 64, "rounds_per_sec": 0.9, "platform": "tpu"}]
+    smoke = [{"wave_size": 64, "rounds_per_sec": 5.0, "platform": "cpu"}]
+    bad = [{"wave_size": 64, "failed": "timeout"}]
+
+    # no prior artifact: failures may write to the primary path
+    assert ws.resolve_out_path(str(out), bad) == str(out)
+    # prior artifact with TPU numbers: failures are diverted...
+    out.write_text(json.dumps({"results": good}))
+    assert ws.resolve_out_path(str(out), bad) == str(out.with_name(
+        "sweep_failed.json"))
+    # ...and so is a CPU smoke run (plausible numbers, wrong platform)
+    assert ws.resolve_out_path(str(out), smoke) == str(out.with_name(
+        "sweep_failed.json"))
+    # a run with a TPU success always takes the primary path
+    assert ws.resolve_out_path(str(out), good + bad) == str(out)
+    # prior artifact that was itself TPU-less: overwrite is fine
+    out.write_text(json.dumps({"results": bad}))
+    assert ws.resolve_out_path(str(out), bad) == str(out)
+    out.write_text(json.dumps({"results": smoke}))
+    assert ws.resolve_out_path(str(out), bad) == str(out)
