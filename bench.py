@@ -192,7 +192,7 @@ def _recorded_wave1024():
     for rec in _iter_jsonl_records(path):
         if (rec.get("stage") == "wave1024"
                 and rec.get("platform") == "tpu"
-                and "rounds_per_sec" in rec):
+                and isinstance(rec.get("rounds_per_sec"), (int, float))):
             if best is None or (rec["rounds_per_sec"]
                                 > best["rounds_per_sec"]):
                 best = {
@@ -240,8 +240,10 @@ def _recorded_flagship_mfu():
                         "benchmarks", "r4_tpu_results.jsonl")
     out = []
     for rec in _iter_jsonl_records(path):
-        if (rec.get("platform") == "tpu" and rec.get("mfu")
-                and rec.get("stage") in ("bert", "llama")):
+        stage = rec.get("stage") or ""
+        if (rec.get("platform") == "tpu"
+                and isinstance(rec.get("mfu"), (int, float)) and rec["mfu"]
+                and (stage.startswith("bert") or stage.startswith("llama"))):
             out.append({
                 "model": rec.get("model"),
                 "mfu": rec["mfu"],

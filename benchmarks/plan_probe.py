@@ -1,5 +1,5 @@
 """Diagnose WHY XLA's static memory plan overcounts the executed peak
-for the FedSim wave kernels (TPU_EVIDENCE_r4.md "Open question").
+for the direct-conv FedSim wave kernels (TPU_EVIDENCE_r4.md).
 
 Hardware anchors on the v5e (16 GiB): the round-3 sweep EXECUTED the
 wave-64 ResNet kernel whose plan measures 17.42 GiB, while the
@@ -7,9 +7,12 @@ full-cohort wave-128 kernel OOM'd. So the plan's byte accounting
 (args + outputs + temps - aliases) exceeds the real allocator peak by
 >= 1.5 GiB for this kernel class. This probe prints the per-component
 breakdown for the wave-32/64 kernels so the overcount can be attributed
-(oversized temp plan from padding? args counted that alias at runtime?)
-and the guard calibration (profiling.HBM_BUDGET_GB) can be justified in
-bytes rather than by anchor alone.
+in bytes and the anchored guard tier
+(profiling.ANCHORED_DIRECT_CONV_BUDGET_GB) justified beyond the anchor.
+
+Measures EXACTLY the kernel the sweep/guard protect: the workload comes
+from wave_sweep.build_benchmark_fedsim and the byte accounting from
+profiling.plan_breakdown_gb — the same code paths, not copies.
 
 Prints one JSON line per kernel; safe to run any time the tunnel is
 live (compiles only — never executes the programs).
@@ -22,44 +25,29 @@ import os
 import sys
 import time
 
+# runnable as `python benchmarks/plan_probe.py` without an installed
+# package: the repo root is one level up
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+
 
 def main() -> None:
     import jax
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                       "/tmp/baton_tpu_jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from baton_tpu.utils.profiling import (
+        _lower_wave_kernel,
+        configure_jax_for_bench,
+        plan_breakdown_gb,
+    )
+    from wave_sweep import build_benchmark_fedsim
 
-    import jax.numpy as jnp
-    import numpy as np
-
-    from baton_tpu.models.resnet import resnet18_cifar_model
-    from baton_tpu.ops.padding import stack_client_datasets
-    from baton_tpu.parallel.engine import FedSim
-    from baton_tpu.utils.profiling import _lower_wave_kernel
-
+    configure_jax_for_bench()
     dev = jax.devices()[0]
-    rng = np.random.default_rng(0)
-    spc = 48
-    # 128-client cohort is enough: the wave kernel only sees wave-sized
-    # slices, so its plan is cohort-size independent (the w32 plan from
-    # the 1024-cohort child can be cross-checked against this one)
-    datasets = [{
-        "x": rng.normal(size=(spc, 32, 32, 3)).astype(np.float32),
-        "y": rng.integers(0, 10, size=(spc,)).astype(np.int32),
-    } for _ in range(128)]
-    data, n_samples = stack_client_datasets(datasets, batch_size=32)
-    data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
-    n_samples = jnp.asarray(n_samples)
-
-    model = resnet18_cifar_model(compute_dtype=jnp.bfloat16)
-    params = model.init(jax.random.key(0))
-    sim = FedSim(model, batch_size=32, learning_rate=0.05)
-    key = jax.random.key(1)
+    sim, params, data, n_samples, key = build_benchmark_fedsim()
 
     for w in (32, 64):
         t0 = time.perf_counter()
@@ -69,21 +57,8 @@ def main() -> None:
         try:
             jitted, args = _lower_wave_kernel(sim, params, data, n_samples,
                                               key, wave_size=w)
-            ma = jitted.lower(*args).compile().memory_analysis()
-            rec.update({
-                "argument_gb": round(ma.argument_size_in_bytes / 2**30, 3),
-                "output_gb": round(ma.output_size_in_bytes / 2**30, 3),
-                "temp_gb": round(ma.temp_size_in_bytes / 2**30, 3),
-                "alias_gb": round(ma.alias_size_in_bytes / 2**30, 3),
-                "plan_gb": round(
-                    (ma.argument_size_in_bytes + ma.output_size_in_bytes
-                     + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
-                    / 2**30, 3),
-                "generated_code_gb": round(
-                    getattr(ma, "generated_code_size_in_bytes", 0) / 2**30,
-                    3),
-                "compile_s": round(time.perf_counter() - t0, 1),
-            })
+            rec.update(plan_breakdown_gb(jitted, args))
+            rec["compile_s"] = round(time.perf_counter() - t0, 1)
         except Exception as e:
             rec["error"] = f"{type(e).__name__}: {e}"[:400]
         print(json.dumps(rec), flush=True)

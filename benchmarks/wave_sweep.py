@@ -35,21 +35,15 @@ WAVES = (16, 32, 64, 128)
 CHILD_TIMEOUT_S = 420.0
 
 
-def run_one(wave_size: int) -> dict:
-    t_child = time.perf_counter()
-
+def build_benchmark_fedsim(n_clients: int = N_CLIENTS,
+                           samples_per_client: int = SAMPLES_PER_CLIENT,
+                           batch_size: int = BATCH_SIZE):
+    """The canonical benchmark workload every plan/sweep tool must agree
+    on: CIFAR-shaped `default_rng(0)` clients + ResNet-18 bf16 FedSim.
+    Returns ``(sim, params, data, n_samples, key)``. Shared by
+    ``run_one`` and ``plan_probe.py`` so the guard-calibration probe
+    measures exactly the kernel the sweep executes."""
     import jax
-
-    # env-var platform overrides are unreliable against the axon plugin;
-    # honor an explicit cpu request through jax.config (deterministic)
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/baton_tpu_jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
     import jax.numpy as jnp
     import numpy as np
 
@@ -57,27 +51,38 @@ def run_one(wave_size: int) -> dict:
     from baton_tpu.ops.padding import stack_client_datasets
     from baton_tpu.parallel.engine import FedSim
 
-    dev = jax.devices()[0]
     rng = np.random.default_rng(0)
     datasets = [
         {
             "x": rng.normal(
-                size=(SAMPLES_PER_CLIENT, 32, 32, 3)
+                size=(samples_per_client, 32, 32, 3)
             ).astype(np.float32),
             "y": rng.integers(
-                0, 10, size=(SAMPLES_PER_CLIENT,)
+                0, 10, size=(samples_per_client,)
             ).astype(np.int32),
         }
-        for _ in range(N_CLIENTS)
+        for _ in range(n_clients)
     ]
-    data, n_samples = stack_client_datasets(datasets, batch_size=BATCH_SIZE)
+    data, n_samples = stack_client_datasets(datasets, batch_size=batch_size)
     data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
     n_samples = jnp.asarray(n_samples)
 
     model = resnet18_cifar_model(compute_dtype=jnp.bfloat16)
     params = model.init(jax.random.key(0))
-    sim = FedSim(model, batch_size=BATCH_SIZE, learning_rate=0.05)
-    key = jax.random.key(1)
+    sim = FedSim(model, batch_size=batch_size, learning_rate=0.05)
+    return sim, params, data, n_samples, jax.random.key(1)
+
+
+def run_one(wave_size: int) -> dict:
+    t_child = time.perf_counter()
+
+    import jax
+
+    from baton_tpu.utils.profiling import configure_jax_for_bench
+
+    configure_jax_for_bench()
+    dev = jax.devices()[0]
+    sim, params, data, n_samples, key = build_benchmark_fedsim()
 
     t_c = time.perf_counter()
     res = sim.run_round(params, data, n_samples, key, n_epochs=N_EPOCHS,

@@ -377,12 +377,19 @@ def test_hbm_budget_device_mapping():
         def __init__(self, kind):
             self.device_kind = kind
 
-    # v5e is plan-space calibrated against executed hardware anchors
-    # (r3 wave-64 plan 17.42 ran; full-cohort ~22 OOM'd)
-    assert hbm_budget_gb(D("TPU v5 lite")) == 17.5
+    # default tier: conservative capacity-minus-headroom (plan ~= real
+    # for matmul-shaped kernels — admitting more would execute real OOMs)
+    assert hbm_budget_gb(D("TPU v5 lite")) == 13.5
     assert hbm_budget_gb(D("TPU v4")) == 29.0
     assert hbm_budget_gb(D("TPU v5p")) == 90.0
     assert hbm_budget_gb(D("weird accelerator")) == 13.5  # conservative
+    # anchored tier: direct-conv wave kernels only, where the plan
+    # provably overcounts (r3 wave-64 plan 17.42 ran; ~22 OOM'd)
+    assert hbm_budget_gb(D("TPU v5 lite"), "anchored_direct_conv") == 17.5
+    assert hbm_budget_gb(D("TPU v5e"), "anchored_direct_conv") == 17.5
+    # no anchor recorded for other generations: overlay falls through
+    assert hbm_budget_gb(D("TPU v4"), "anchored_direct_conv") == 29.0
+    assert hbm_budget_gb(D("weird"), "anchored_direct_conv") == 13.5
 
 
 def test_plan_gb_treats_compile_oom_as_infinite():
