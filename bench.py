@@ -291,17 +291,13 @@ def main() -> None:
 
     import jax
 
-    if plat:
-        # belt and braces: the env var is pinned by sitecustomize, so pin
-        # through jax.config as well (config wins over the env var)
-        jax.config.update("jax_platforms", plat)
+    from baton_tpu.utils.profiling import configure_jax_for_bench
 
-    # Persistent compilation cache: the dominant cost of this bench is the
-    # one-time XLA compile of the round program; cache it across runs.
-    cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR", "/tmp/baton_tpu_jax_cache")
-    jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # shared setup: pins an explicit cpu probe decision through
+    # jax.config (the env var alone is unreliable against the axon
+    # plugin) and enables the persistent compilation cache — the
+    # dominant cost of this bench is the one-time XLA compile
+    configure_jax_for_bench()
 
     import jax.numpy as jnp
     import numpy as np

@@ -64,20 +64,9 @@ SMOKE = os.environ.get("BATON_SUITE_SMOKE") == "1"
 def _jax_setup():
     import jax
 
-    # The JAX_PLATFORMS env var does NOT reliably override the axon
-    # plugin this container registers at interpreter startup — a child
-    # meaning to run on CPU can still dial the (possibly dark) tunnel
-    # at first backend touch and hang for its whole timeout. Only
-    # jax.config pins the platform deterministically; honor an explicit
-    # cpu request through it before any backend initialization.
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                       "/tmp/baton_tpu_jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from baton_tpu.utils.profiling import configure_jax_for_bench
+
+    configure_jax_for_bench()
     return jax
 
 
@@ -329,6 +318,16 @@ def child_bert() -> dict:
 
     sim = FedSim(model, batch_size=B, learning_rate=0.01)
     key = jax.random.key(1)
+    stage_name = "bert" if B == 32 or SMOKE else f"bert_b{B}"
+    # OOM guard (matmul-shaped kernel: the plan tracks real allocation,
+    # so the conservative default budget applies — the b64 push stage
+    # roughly doubles the measured 7.8 GB b32 footprint)
+    from baton_tpu.utils.profiling import fedsim_wave_plan_gb, hbm_budget_gb
+    plan_gb = fedsim_wave_plan_gb(sim, params, data, n_samples, key)
+    if plan_gb is not None and plan_gb > hbm_budget_gb(dev):
+        return {"stage": stage_name, "platform": dev.platform,
+                "model": "bert_base_bf16", "clients": C, "batch": B,
+                "seq_len": L, **_plan_skip_fields(plan_gb)}
     t_child = time.perf_counter()
     p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key,
                                      2 if SMOKE else 10)
@@ -353,7 +352,7 @@ def child_bert() -> dict:
     flops = xla_flops or analytic_flops
     sps = C * B / dt
     return {
-        "stage": "bert" if B == 32 or SMOKE else f"bert_b{B}",
+        "stage": stage_name,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "model": "bert_base_bf16", "n_params": n_params,
@@ -419,6 +418,15 @@ def child_llama() -> dict:
     sim = FedSim(model, batch_size=B, learning_rate=1e-3,
                  trainable=lora_trainable)
     key = jax.random.key(1)
+    stage_name = "llama" if B == 4 or SMOKE else f"llama_b{B}"
+    # OOM guard (matmul-shaped: plan ~= real; b4 measured 6.45 GB, the
+    # b8 push roughly doubles it)
+    from baton_tpu.utils.profiling import fedsim_wave_plan_gb, hbm_budget_gb
+    plan_gb = fedsim_wave_plan_gb(sim, params, data, n_samples, key)
+    if plan_gb is not None and plan_gb > hbm_budget_gb(dev):
+        return {"stage": stage_name, "platform": dev.platform,
+                "model": "llama0.9b_lora_bf16_remat", "clients": C,
+                "batch": B, "seq_len": L, **_plan_skip_fields(plan_gb)}
     t_child = time.perf_counter()
     p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key,
                                      2 if SMOKE else 6)
@@ -445,7 +453,7 @@ def child_llama() -> dict:
     # reported under its own key, never blended into mfu.
     analytic_flops = 4.0 * n_params * tokens
     return {
-        "stage": "llama" if B == 4 or SMOKE else f"llama_b{B}",
+        "stage": stage_name,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "model": "llama0.9b_lora_bf16_remat", "n_params": n_params,
