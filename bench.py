@@ -243,7 +243,8 @@ def _recorded_flagship_mfu():
         stage = rec.get("stage") or ""
         if (rec.get("platform") == "tpu"
                 and isinstance(rec.get("mfu"), (int, float)) and rec["mfu"]
-                and (stage.startswith("bert") or stage.startswith("llama"))):
+                and (stage.startswith("bert") or stage.startswith("llama")
+                     or stage.startswith("vit"))):
             out.append({
                 "model": rec.get("model"),
                 "mfu": rec["mfu"],

@@ -115,6 +115,46 @@ def _cost_flops(jitted, *args):
         return None
 
 
+def _flagship_oom_guard(sim, params, data, n_samples, key, dev,
+                        kernel_class: str = "default"):
+    """Shared static-plan OOM guard for the flagship stages
+    (bert/vit/llama and their batch-push variants): returns None when
+    the plan fits the device budget, else the skip-record fields."""
+    from baton_tpu.utils.profiling import fedsim_wave_plan_gb, hbm_budget_gb
+
+    plan_gb = fedsim_wave_plan_gb(sim, params, data, n_samples, key)
+    if plan_gb is not None and plan_gb > hbm_budget_gb(dev, kernel_class):
+        return _plan_skip_fields(plan_gb)
+    return None
+
+
+def _flagship_flop_probe(sim, p, data, n_samples, key, n_clients,
+                         t_child, budget_s, split_frozen=False):
+    """Shared measured-FLOP + HBM probe for the flagship stages: jit the
+    wave kernel, ask XLA's cost analysis for its FLOPs, and return
+    ``(jitted, xla_flops, hbm_args)`` for the peak-HBM fallback.
+    Budget-gated: the probe compiles a fresh program and must never
+    starve the already-measured result."""
+    import jax
+
+    if time.perf_counter() - t_child >= budget_s:
+        return None, None, None
+    rngs = jax.random.split(key, n_clients)
+    try:
+        if split_frozen:
+            tr, fz = sim._split(p)
+            jitted = jax.jit(
+                lambda a, b, d, n, r: sim._wave_sums_raw(a, b, d, n, r, 1))
+            args = (tr, fz, data, n_samples, rngs)
+        else:
+            jitted = jax.jit(
+                lambda pr, d, n, r: sim._wave_sums_raw(pr, None, d, n, r, 1))
+            args = (p, data, n_samples, rngs)
+        return jitted, _cost_flops(jitted, *args), args
+    except Exception:
+        return None, None, None
+
+
 # ======================================================================
 # stage: conv — the grouped-conv shootout
 def child_conv() -> dict:
@@ -319,33 +359,21 @@ def child_bert() -> dict:
     sim = FedSim(model, batch_size=B, learning_rate=0.01)
     key = jax.random.key(1)
     stage_name = "bert" if B == 32 or SMOKE else f"bert_b{B}"
-    # OOM guard (matmul-shaped kernel: the plan tracks real allocation,
-    # so the conservative default budget applies — the b64 push stage
-    # roughly doubles the measured 7.8 GB b32 footprint)
-    from baton_tpu.utils.profiling import fedsim_wave_plan_gb, hbm_budget_gb
-    plan_gb = fedsim_wave_plan_gb(sim, params, data, n_samples, key)
-    if plan_gb is not None and plan_gb > hbm_budget_gb(dev):
+    # matmul-shaped kernel: the plan tracks real allocation, so the
+    # conservative default budget applies — the b64 push stage roughly
+    # doubles the measured 7.8 GB b32 footprint
+    skip = _flagship_oom_guard(sim, params, data, n_samples, key, dev)
+    if skip is not None:
         return {"stage": stage_name, "platform": dev.platform,
                 "model": "bert_base_bf16", "clients": C, "batch": B,
-                "seq_len": L, **_plan_skip_fields(plan_gb)}
+                "seq_len": L, **skip}
     t_child = time.perf_counter()
     p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key,
                                      2 if SMOKE else 10)
 
-    # XLA's own FLOP count for the wave kernel — measured, not analytic.
-    # Budget-gated (900 s child timeout, 300 s reserve): the probe
-    # compiles a fresh program and must not starve the measured result.
-    rngs = jax.random.split(key, C)
-    jitted = xla_flops = None
-    hbm_args = None
-    if time.perf_counter() - t_child < 600.0:
-        try:
-            jitted = jax.jit(
-                lambda pr, d, n, r: sim._wave_sums_raw(pr, None, d, n, r, 1))
-            xla_flops = _cost_flops(jitted, p, data, n_samples, rngs)
-            hbm_args = (p, data, n_samples, rngs)
-        except Exception:
-            jitted = None
+    # measured-FLOP probe, gated at 600 s of the 900 s child timeout
+    jitted, xla_flops, hbm_args = _flagship_flop_probe(
+        sim, p, data, n_samples, key, C, t_child, 600.0)
 
     tokens_per_round = C * B * L
     analytic_flops = 6.0 * n_params * tokens_per_round
@@ -360,6 +388,76 @@ def child_bert() -> dict:
         "rounds_per_sec": round(1 / dt, 3),
         "samples_per_sec_per_chip": round(sps, 1),
         "tokens_per_sec_per_chip": round(sps * L, 1),
+        "flops_per_round_xla": xla_flops,
+        "flops_per_round_analytic": analytic_flops,
+        "mfu": round(flops / dt / V5E_PEAK_BF16, 4),
+        "mfu_analytic": round(analytic_flops / dt / V5E_PEAK_BF16, 4),
+        "compile_s": round(compile_s, 1),
+        "peak_hbm_gb": _peak_hbm_gb(dev, jitted, hbm_args),
+    }
+
+
+# ======================================================================
+# stage: vit — the config-5 flagship: ViT-B/16 federated rounds, the
+# last BASELINE model family without a hardware MFU record (ResNet:
+# headline/waves; BERT: config 3; Llama: config 4). Per-client weights
+# live entirely in matmuls (patchify is a reshape/transpose — no conv),
+# so vmapped training lowers to batched matmuls like BERT.
+def child_vit() -> dict:
+    jax = _jax_setup()
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    from baton_tpu.models.vit import ViTConfig, vit_model
+    from baton_tpu.ops.padding import stack_client_datasets
+    from baton_tpu.parallel.engine import FedSim
+
+    if SMOKE:
+        C, B = 2, 4
+        cfg = ViTConfig.tiny()
+    else:
+        C, B = 4, 16
+        cfg = ViTConfig.b16(n_classes=100)  # 224px, patch 16 -> 196 tokens
+    model = vit_model(cfg, compute_dtype=jnp.bfloat16, name="vit_b16_bf16")
+    params = model.init(jax.random.key(0))
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+
+    rng = np.random.default_rng(0)
+    datasets = [{
+        "x": rng.normal(size=(B, cfg.image_size, cfg.image_size,
+                              cfg.channels)).astype(np.float32),
+        "y": rng.integers(0, cfg.n_classes, size=(B,)).astype(np.int32),
+    } for _ in range(C)]
+    data, n_samples = stack_client_datasets(datasets, batch_size=B)
+    data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
+    n_samples = jnp.asarray(n_samples)
+
+    sim = FedSim(model, batch_size=B, learning_rate=0.01)
+    key = jax.random.key(1)
+    skip = _flagship_oom_guard(sim, params, data, n_samples, key, dev)
+    if skip is not None:
+        return {"stage": "vit", "platform": dev.platform,
+                "model": "vit_b16_bf16", "clients": C, "batch": B, **skip}
+    t_child = time.perf_counter()
+    p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key,
+                                     2 if SMOKE else 10)
+
+    jitted, xla_flops, hbm_args = _flagship_flop_probe(
+        sim, p, data, n_samples, key, C, t_child, 600.0)
+
+    tokens = cfg.n_patches + 1  # + class token
+    analytic_flops = 6.0 * n_params * C * B * tokens
+    flops = xla_flops or analytic_flops
+    sps = C * B / dt
+    return {
+        "stage": "vit", "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "model": "vit_b16_bf16", "n_params": n_params,
+        "clients": C, "batch": B, "n_tokens": tokens,
+        "rounds_per_sec": round(1 / dt, 3),
+        "samples_per_sec_per_chip": round(sps, 1),
         "flops_per_round_xla": xla_flops,
         "flops_per_round_analytic": analytic_flops,
         "mfu": round(flops / dt / V5E_PEAK_BF16, 4),
@@ -419,31 +517,22 @@ def child_llama() -> dict:
                  trainable=lora_trainable)
     key = jax.random.key(1)
     stage_name = "llama" if B == 4 or SMOKE else f"llama_b{B}"
-    # OOM guard (matmul-shaped: plan ~= real; b4 measured 6.45 GB, the
-    # b8 push roughly doubles it)
-    from baton_tpu.utils.profiling import fedsim_wave_plan_gb, hbm_budget_gb
-    plan_gb = fedsim_wave_plan_gb(sim, params, data, n_samples, key)
-    if plan_gb is not None and plan_gb > hbm_budget_gb(dev):
+    # matmul-shaped: plan ~= real; b4 measured 6.45 GB, the b8 push
+    # roughly doubles it
+    skip = _flagship_oom_guard(sim, params, data, n_samples, key, dev)
+    if skip is not None:
         return {"stage": stage_name, "platform": dev.platform,
                 "model": "llama0.9b_lora_bf16_remat", "clients": C,
-                "batch": B, "seq_len": L, **_plan_skip_fields(plan_gb)}
+                "batch": B, "seq_len": L, **skip}
     t_child = time.perf_counter()
     p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key,
                                      2 if SMOKE else 6)
 
-    # probes below each COMPILE a fresh program; gate on the child's
-    # 1200 s budget so a slow tunnel compile can't discard the
-    # already-measured rounds (300 s reserve)
-    jitted = xla_flops = None
-    if time.perf_counter() - t_child < 900.0 - compile_s:
-        tr, fz = sim._split(p)
-        rngs = jax.random.split(key, C)
-        try:
-            jitted = jax.jit(
-                lambda a, b, d, n, r: sim._wave_sums_raw(a, b, d, n, r, 1))
-            xla_flops = _cost_flops(jitted, tr, fz, data, n_samples, rngs)
-        except Exception:
-            jitted = None
+    # measured-FLOP probe: gate on the child's 1200 s budget so a slow
+    # tunnel compile can't discard the already-measured rounds
+    jitted, xla_flops, hbm_args = _flagship_flop_probe(
+        sim, p, data, n_samples, key, C, t_child, 900.0 - compile_s,
+        split_frozen=True)
 
     tokens = C * B * L
     # Model-FLOPs for an adapters-only LoRA step: fwd 2PN + activation
@@ -466,9 +555,7 @@ def child_llama() -> dict:
         "hfu_xla": (round(xla_flops / dt / V5E_PEAK_BF16, 4)
                     if xla_flops else None),
         "compile_s": round(compile_s, 1),
-        "peak_hbm_gb": _peak_hbm_gb(
-            dev, jitted, (tr, fz, data, n_samples, rngs)
-            if jitted is not None else None),
+        "peak_hbm_gb": _peak_hbm_gb(dev, jitted, hbm_args),
         "remat": True,
     }
 
@@ -813,6 +900,8 @@ def main() -> None:
             print(json.dumps(child_bert()))
         elif args.child == "llama":
             print(json.dumps(child_llama()))
+        elif args.child == "vit":
+            print(json.dumps(child_vit()))
         elif args.child == "wave1024":
             print(json.dumps(child_wave1024(args.wave, args.conv_impl,
                                             args.batch)))
@@ -849,6 +938,8 @@ def main() -> None:
         elif stage == "llama_b8":
             run_child([py, me, "--child", "llama"], 1200, "llama_b8",
                       {"BATON_SUITE_LLAMA_BATCH": "8"})
+        elif stage == "vit":
+            run_child([py, me, "--child", "vit"], 900, "vit")
         elif stage == "wave1024":
             impl, bs = _conv_winner()
             # im2col's patch blowup may exceed HBM at large waves: the
