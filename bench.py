@@ -33,8 +33,10 @@ N_CLIENTS = 32           # one v4-32 chip's shard of 1024 clients
 SAMPLES_PER_CLIENT = 48  # ~50_000 / 1024
 # 48-sample clients at batch 32 train one full + one HALF-PADDED batch
 # per epoch (64 sample-slots of conv FLOPs for 48 real samples — 25%
-# waste); BATON_BENCH_BATCH=48 removes the padding batch. Default stays
-# 32 until the r4 suite's conv stage measures the win on hardware.
+# waste); BATON_BENCH_BATCH=48 removes the padding batch. When the env
+# var is unset, main() auto-adopts batch (and conv lowering) from the
+# last TPU-recorded conv-shootout winner; this constant is the fallback
+# when no hardware record exists.
 BATCH_SIZE = int(os.environ.get("BATON_BENCH_BATCH", "32"))
 N_EPOCHS = 1
 TARGET_ROUNDS_PER_SEC = 10.0
@@ -52,8 +54,9 @@ PROBE_TIMEOUTS_S = (
 )
 PROBE_RETRY_COOLDOWN_S = 15.0
 
-# ResNet-18 (CIFAR-10 variant, 32x32 input): 0.557 GMAC forward per image
-# = 1.11 GFLOP (x2 MAC->FLOP); training approx 3x forward (fwd + 2x bwd).
+# ResNet-18 (CIFAR-10 variant, 32x32 input): 0.557 GMAC forward per
+# image = 1.11 GFLOP (x2 MAC->FLOP); training ~3x forward (fwd + 2x
+# bwd).
 RESNET18_CIFAR_FWD_FLOPS_PER_IMG = 1.11e9
 TRAIN_FLOPS_PER_IMG = 3.0 * RESNET18_CIFAR_FWD_FLOPS_PER_IMG
 
@@ -260,6 +263,34 @@ def _recorded_flagship_mfu():
             "records": out}
 
 
+def _recorded_conv_winner():
+    """Winning per-client-conv lowering (impl, batch_size) from the r4
+    suite's conv shootout, trusted only from TPU-platform records — a
+    CPU smoke run's winner must never steer the headline config.
+    Returns None when no hardware shootout has landed."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "r4_tpu_results.jsonl")
+    winner = None
+    for rec in _iter_jsonl_records(path):
+        if rec.get("stage") != "conv" or rec.get("platform") != "tpu":
+            continue
+        fm = rec.get("full_model")
+        if not isinstance(fm, dict):
+            continue
+        best = None
+        for tag, r in fm.items():
+            if (isinstance(r, dict)
+                    and isinstance(r.get("rounds_per_sec"), (int, float))):
+                if best is None or r["rounds_per_sec"] > best[1]:
+                    best = (tag, r["rounds_per_sec"], r.get("batch_size", 32))
+        if best is not None:
+            bs = best[2] if isinstance(best[2], (int, float)) else 32
+            winner = {"impl": best[0].split("_b")[0],
+                      "rounds_per_sec": best[1],
+                      "batch_size": int(bs) if bs > 0 else 32}
+    return winner
+
+
 def _recorded_wave_sweep():
     """Best setting from the last benchmarks/wave_sweep.py run on TPU.
     Explicitly labeled recorded-not-measured: it is a separate artifact
@@ -323,6 +354,35 @@ def main() -> None:
         (8, 32) if degraded else (N_CLIENTS, SAMPLES_PER_CLIENT)
     )
 
+    # conv lowering + per-client batch for the headline: explicit env
+    # overrides win; otherwise adopt the conv-shootout winner from the
+    # last TPU-platform suite record ("im2col" keeps the FLOPs in
+    # MXU-tiled batched matmuls instead of C-group grouped convolutions
+    # — models/resnet.py::_conv_im2col; batch 48 deletes the
+    # half-padded second batch of the 48-sample clients). Same FedAvg
+    # experiment either way — the JSON carries conv_impl/batch_size so
+    # configs stay distinguishable without renaming the model.
+    conv_impl, batch_size, conv_winner = "direct", BATCH_SIZE, None
+    if not degraded:
+        env_impl = os.environ.get("BATON_BENCH_CONV_IMPL")
+        env_batch = os.environ.get("BATON_BENCH_BATCH")
+        conv_winner = _recorded_conv_winner()
+        adopted = []
+        if env_impl:
+            conv_impl = env_impl
+        elif conv_winner:
+            conv_impl = conv_winner["impl"]
+            adopted.append(f"impl={conv_impl}")
+        # BATCH_SIZE already reflects an env override; only the
+        # no-override case consults the record
+        if env_batch is None and conv_winner:
+            batch_size = conv_winner["batch_size"]
+            adopted.append(f"batch={batch_size}")
+        if adopted:
+            log(f"adopting from TPU-recorded conv-shootout winner "
+                f"({conv_winner['rounds_per_sec']} rounds/s recorded): "
+                + ", ".join(adopted))
+
     rng = np.random.default_rng(0)
     datasets = []
     for _ in range(n_clients):
@@ -330,7 +390,7 @@ def main() -> None:
             "x": rng.normal(size=(samples_per_client, 32, 32, 3)).astype(np.float32),
             "y": rng.integers(0, 10, size=(samples_per_client,)).astype(np.int32),
         })
-    data, n_samples = stack_client_datasets(datasets, batch_size=BATCH_SIZE)
+    data, n_samples = stack_client_datasets(datasets, batch_size=batch_size)
     data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
     n_samples = jnp.asarray(n_samples)
     log("client data staged on device")
@@ -343,18 +403,11 @@ def main() -> None:
                                 name="cnn_cpu_fallback")
         model_name = "cnn_cpu_fallback"
     else:
-        # conv lowering for the vmapped per-client convs: "im2col" keeps
-        # the FLOPs in MXU-tiled batched matmuls instead of C-group
-        # grouped convolutions (models/resnet.py::_conv_im2col). The
-        # default should track whichever the r4 suite's "conv" stage
-        # (benchmarks/r4_tpu_results.jsonl) measures faster on hardware.
-        conv_impl = os.environ.get("BATON_BENCH_CONV_IMPL", "direct")
         model = resnet18_cifar_model(compute_dtype=jnp.bfloat16,
                                      conv_impl=conv_impl)
-        model_name = ("resnet18_bf16" if conv_impl == "direct"
-                      else f"resnet18_bf16_{conv_impl}")
+        model_name = "resnet18_bf16"
     params = model.init(jax.random.key(0))
-    sim = FedSim(model, batch_size=BATCH_SIZE, learning_rate=0.05)
+    sim = FedSim(model, batch_size=batch_size, learning_rate=0.05)
     key = jax.random.key(1)
 
     # OOM guard (non-default conv lowerings only — the direct full-wave
@@ -548,7 +601,9 @@ def main() -> None:
         "model": model_name,
         "clients": n_clients,
         "samples_per_client": samples_per_client,
-        "batch_size": BATCH_SIZE,
+        "batch_size": batch_size,
+        "conv_impl": None if degraded else conv_impl,
+        "conv_winner_recorded": conv_winner,
         # None = the whole cohort in one wave; set when the OOM guard
         # degraded a non-default lowering to waves (a DIFFERENT program
         # from the full-wave headline config — must be distinguishable)

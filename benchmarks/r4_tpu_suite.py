@@ -756,32 +756,23 @@ def _plan_skip_fields(plan_gb: float) -> dict:
 
 
 def _conv_winner(default: str = "direct") -> tuple:
-    """Read the conv shootout's full-model winner (lowering impl AND
-    local batch size) from the results JSONL so downstream 1024-client
-    stages run the fastest measured configuration."""
+    """Conv-shootout full-model winner (lowering impl AND local batch
+    size) steering the downstream 1024-client stages. Single source of
+    truth: bench.py's `_recorded_conv_winner` (repo root is on the
+    suite's path — run_child sets PYTHONPATH=REPO and cwd=REPO), which
+    trusts only TPU-platform records so a CPU smoke run can never steer
+    the scarce hardware stages."""
     try:
-        with open(OUT_JSONL) as f:
-            lines = f.readlines()
-    except OSError:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from bench import _recorded_conv_winner
+
+        w = _recorded_conv_winner()
+    except Exception:
         return default, 32
-    for line in reversed(lines):
-        try:
-            rec = json.loads(line)
-        except ValueError:
-            continue
-        # only trust TPU-platform records: a smoke/CPU plumbing run's
-        # batch size must never steer the scarce hardware stages
-        if (rec.get("stage") == "conv" and rec.get("full_model")
-                and rec.get("platform") == "tpu"):
-            fm = rec["full_model"]
-            best = max(
-                (i for i in fm if "rounds_per_sec" in fm[i]),
-                key=lambda i: fm[i]["rounds_per_sec"], default=None)
-            if best is None:
-                return default, 32
-            impl = best.split("_b")[0]  # "im2col_b48" -> "im2col"
-            return impl, int(fm[best].get("batch_size", 32))
-    return default, 32
+    if w is None:
+        return default, 32
+    return w["impl"], w["batch_size"]
 
 
 # set after two consecutive silent startup hangs: the tunnel is dark,

@@ -1,0 +1,187 @@
+"""Full Bonawitz secure-aggregation rounds over real HTTP at cross-silo
+scale: C in {16, 64, 128} members in one process, with dropouts
+recovered via Shamir (VERDICT r3 item 6, extended past the 64 the test
+suite pins).
+
+This complements ``secure_scaling.py`` (per-component host crypto
+costs): here the WHOLE protocol runs — manager + C aiohttp workers on
+localhost sockets, AdvertiseKeys -> ShareKeys (O(C^2) sealed boxes) ->
+masked uploads -> Unmasking with Shamir recovery for the dropouts —
+and the aggregate is checked against plain weighted FedAvg over the
+reporters. Wall-clock per cohort size lands in
+``benchmarks/secure_round_scale.json``.
+
+Caveat printed into the artifact: all C clients' O(C) DH modexps run
+SERIALIZED in this single container process; a real deployment does
+that per-client work on C separate hosts, so per-round wall-clock
+there is dominated by the server-side O(C^2) share routing instead.
+
+Run anywhere (no TPU needed):
+    python benchmarks/secure_round_scale.py [--cohorts 16,64,128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import socket
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from baton_tpu.utils.profiling import configure_jax_for_bench  # noqa: E402
+
+# MUST run before any backend touch: the env var alone does not reliably
+# override the axon plugin, and a dark tunnel would hang the first jit
+configure_jax_for_bench()
+
+import numpy as np  # noqa: E402
+from aiohttp import web  # noqa: E402
+
+from baton_tpu.core.training import make_local_trainer  # noqa: E402
+from baton_tpu.data.synthetic import linear_client_data  # noqa: E402
+from baton_tpu.models.linear import linear_regression_model  # noqa: E402
+from baton_tpu.server.http_manager import Manager  # noqa: E402
+from baton_tpu.server.http_worker import ExperimentWorker  # noqa: E402
+from baton_tpu.server.state import params_to_state_dict  # noqa: E402
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _SilentWorker(ExperimentWorker):
+    """Registers and advertises keys, then never uploads — the dropout
+    whose pairwise masks the survivors must reconstruct."""
+
+    async def report_update(self, round_name, n_samples, loss_history):
+        return None
+
+
+async def _one_cohort(n: int, n_silent: int) -> dict:
+    model = linear_regression_model(10)
+    nprng = np.random.default_rng(1)
+    mport = _free_port()
+
+    mapp = web.Application()
+    manager = Manager(mapp)
+    exp = manager.register_experiment(
+        model, name="securebench", round_timeout=900.0, secure_agg=True
+    )
+    mrunner = web.AppRunner(mapp)
+    await mrunner.setup()
+    await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+    # one shared trainer: a single jit cache entry per data shape
+    # instead of one per worker (compile would dominate at C=128)
+    shared = make_local_trainer(model, batch_size=32, learning_rate=0.02)
+
+    workers, runners = [], [mrunner]
+    t_setup = time.perf_counter()
+    for i in range(n):
+        data = linear_client_data(nprng, min_batches=2, max_batches=3)
+        wport = _free_port()
+        cls = _SilentWorker if i >= n - n_silent else ExperimentWorker
+        wapp = web.Application()
+        worker = cls(
+            wapp, model, f"127.0.0.1:{mport}", name="securebench",
+            port=wport, heartbeat_time=5.0, trainer=shared,
+            get_data=lambda d=data: (d, d["x"].shape[0]),
+        )
+        wrunner = web.AppRunner(wapp)
+        await wrunner.setup()
+        await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+        workers.append(worker)
+        runners.append(wrunner)
+    for _ in range(400):
+        if len(exp.registry) == n:
+            break
+        await asyncio.sleep(0.05)
+    assert len(exp.registry) == n, f"registered {len(exp.registry)}/{n}"
+    setup_s = time.perf_counter() - t_setup
+
+    import aiohttp
+
+    n_report = n - n_silent
+    t0 = time.perf_counter()
+    async with aiohttp.ClientSession() as session:
+        async with session.get(
+            f"http://127.0.0.1:{mport}/securebench/start_round?n_epoch=1"
+        ) as resp:
+            assert resp.status == 200
+        for _ in range(16000):
+            if len(exp.rounds.client_responses) == n_report:
+                break
+            await asyncio.sleep(0.05)
+        assert len(exp.rounds.client_responses) == n_report
+        async with session.get(
+            f"http://127.0.0.1:{mport}/securebench/end_round"
+        ) as resp:
+            state = await resp.json()
+        assert not state["in_progress"]
+    round_s = time.perf_counter() - t0
+
+    # correctness: aggregate == plain weighted FedAvg over reporters
+    num, den = None, 0.0
+    for w in workers[:n_report]:
+        sd = params_to_state_dict(w.params)
+        ns = float(w.get_data()[1])
+        den += ns
+        num = (
+            {k: ns * np.asarray(v, np.float64) for k, v in sd.items()}
+            if num is None
+            else {k: num[k] + ns * np.asarray(v, np.float64)
+                  for k, v in sd.items()}
+        )
+    expected = {k: v / den for k, v in num.items()}
+    got = params_to_state_dict(exp.params)
+    for k in expected:
+        np.testing.assert_allclose(got[k], expected[k], atol=1e-3)
+
+    snap = exp.metrics.snapshot()
+    recovered = snap["counters"].get("secure_dropouts_recovered", 0.0)
+    assert recovered == float(n_silent), (recovered, n_silent)
+
+    for r in runners:
+        await r.cleanup()
+    return {
+        "cohort": n, "dropouts": n_silent,
+        "sealed_boxes": n * (n - 1),
+        "round_s": round(round_s, 2),
+        "setup_s": round(setup_s, 2),
+        "aggregate_matches_fedavg": True,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cohorts", default="16,64,128")
+    args = ap.parse_args()
+    out = {
+        "note": ("all C clients' O(C) DH modexps run serialized in ONE "
+                 "container process; a real deployment spreads that "
+                 "per-client work across C hosts"),
+        "results": [],
+    }
+    for n in (int(x) for x in args.cohorts.split(",")):
+        n_silent = max(1, n // 21)  # 16->1, 64->3, 128->6 dropouts
+        rec = asyncio.new_event_loop().run_until_complete(
+            _one_cohort(n, n_silent))
+        out["results"].append(rec)
+        print(json.dumps(rec), flush=True)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "secure_round_scale.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

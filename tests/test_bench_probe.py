@@ -124,3 +124,40 @@ def test_transient_classifier_defers_to_shared_oom_rule(bench):
     e = RuntimeError("remote_compile: HTTP 500: compile failed; "
                      "Allocation type: HLO temp; 19. Size: 256.00M")
     assert not bench.is_transient_tunnel_error(e)
+
+
+def test_recorded_conv_winner_trusts_only_tpu_records(bench, tmp_path,
+                                                      monkeypatch):
+    """The headline bench auto-adopts the conv-shootout winner — but
+    only from TPU-platform records, never a CPU smoke run, and the last
+    hardware record wins."""
+    import json
+
+    jl = tmp_path / "benchmarks" / "r4_tpu_results.jsonl"
+    jl.parent.mkdir()
+    rows = [
+        {"stage": "conv", "platform": "cpu",
+         "full_model": {"im2col": {"rounds_per_sec": 99.0,
+                                   "batch_size": 48}}},
+        {"stage": "conv", "platform": "tpu",
+         "full_model": {"direct": {"rounds_per_sec": 3.1, "batch_size": 32},
+                        "im2col_b48": {"rounds_per_sec": 7.2,
+                                       "batch_size": 48},
+                        "broken": {"error": "X"},
+                        "skipped": {"skipped": "plan", "plan_gb": None}}},
+        # a later TPU record with a malformed batch_size must not crash
+        # the bench, and falls back to batch 32
+        {"stage": "conv", "platform": "tpu",
+         "full_model": {"im2col": {"rounds_per_sec": 9.9,
+                                   "batch_size": None}}},
+    ]
+    jl.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    # scope the redirect to the module under test (patching the shared
+    # os.path.dirname would affect every caller in the process)
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    w = bench._recorded_conv_winner()
+    assert w == {"impl": "im2col", "rounds_per_sec": 9.9, "batch_size": 32}
+
+    # CPU-only records -> no winner
+    jl.write_text(json.dumps(rows[0]) + "\n")
+    assert bench._recorded_conv_winner() is None
