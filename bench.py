@@ -263,13 +263,15 @@ def _recorded_flagship_mfu():
             "records": out}
 
 
-def _recorded_conv_winner():
+def _recorded_conv_winner(path=None):
     """Winning per-client-conv lowering (impl, batch_size) from the r4
     suite's conv shootout, trusted only from TPU-platform records — a
     CPU smoke run's winner must never steer the headline config.
-    Returns None when no hardware shootout has landed."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "benchmarks", "r4_tpu_results.jsonl")
+    Returns None when no hardware shootout has landed. ``path`` lets
+    the suite (and tests) point at a redirected results JSONL."""
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "r4_tpu_results.jsonl")
     winner = None
     for rec in _iter_jsonl_records(path):
         if rec.get("stage") != "conv" or rec.get("platform") != "tpu":

@@ -767,7 +767,7 @@ def _conv_winner(default: str = "direct") -> tuple:
             sys.path.insert(0, REPO)
         from bench import _recorded_conv_winner
 
-        w = _recorded_conv_winner()
+        w = _recorded_conv_winner(path=OUT_JSONL)
     except Exception:
         return default, 32
     if w is None:
