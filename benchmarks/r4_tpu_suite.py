@@ -413,13 +413,19 @@ def child_vit() -> dict:
     from baton_tpu.ops.padding import stack_client_datasets
     from baton_tpu.parallel.engine import FedSim
 
+    # BATON_SUITE_VIT_DP=1 measures the config-5 shape instead: DP-SGD
+    # per-example clipped gradients (vmapped over the batch — still
+    # batched matmuls) + remat (per-example grads multiply activation
+    # memory by the batch; recompute-not-store pays FLOPs to fit)
+    dp_mode = os.environ.get("BATON_SUITE_VIT_DP") == "1"
     if SMOKE:
         C, B = 2, 4
         cfg = ViTConfig.tiny()
     else:
-        C, B = 4, 16
+        C, B = (4, 8) if dp_mode else (4, 16)
         cfg = ViTConfig.b16(n_classes=100)  # 224px, patch 16 -> 196 tokens
-    model = vit_model(cfg, compute_dtype=jnp.bfloat16, name="vit_b16_bf16")
+    model = vit_model(cfg, compute_dtype=jnp.bfloat16, remat=dp_mode,
+                      name="vit_b16_bf16")
     params = model.init(jax.random.key(0))
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree_util.tree_leaves(params))
@@ -434,12 +440,21 @@ def child_vit() -> dict:
     data = {k: jax.device_put(jnp.asarray(v)) for k, v in data.items()}
     n_samples = jnp.asarray(n_samples)
 
-    sim = FedSim(model, batch_size=B, learning_rate=0.01)
+    dp_cfg = None
+    if dp_mode:
+        from baton_tpu.ops.privacy import DPConfig
+
+        dp_cfg = DPConfig(clip_norm=1.0, noise_multiplier=0.5)
+        sim = FedSim(model, batch_size=B, learning_rate=0.01, dp=dp_cfg)
+    else:
+        sim = FedSim(model, batch_size=B, learning_rate=0.01)
+    stage_name = "vit_dp" if dp_mode else "vit"
+    model_name = "vit_b16_bf16_dp_remat" if dp_mode else "vit_b16_bf16"
     key = jax.random.key(1)
     skip = _flagship_oom_guard(sim, params, data, n_samples, key, dev)
     if skip is not None:
-        return {"stage": "vit", "platform": dev.platform,
-                "model": "vit_b16_bf16", "clients": C, "batch": B, **skip}
+        return {"stage": stage_name, "platform": dev.platform,
+                "model": model_name, "clients": C, "batch": B, **skip}
     t_child = time.perf_counter()
     p, dt, compile_s = _timed_rounds(sim, params, data, n_samples, key,
                                      2 if SMOKE else 10)
@@ -449,22 +464,39 @@ def child_vit() -> dict:
 
     tokens = cfg.n_patches + 1  # + class token
     analytic_flops = 6.0 * n_params * C * B * tokens
-    flops = xla_flops or analytic_flops
     sps = C * B / dt
-    return {
-        "stage": "vit", "platform": dev.platform,
+    rec = {
+        "stage": stage_name, "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", dev.platform),
-        "model": "vit_b16_bf16", "n_params": n_params,
+        "model": model_name, "n_params": n_params,
         "clients": C, "batch": B, "n_tokens": tokens,
         "rounds_per_sec": round(1 / dt, 3),
         "samples_per_sec_per_chip": round(sps, 1),
-        "flops_per_round_xla": xla_flops,
         "flops_per_round_analytic": analytic_flops,
-        "mfu": round(flops / dt / V5E_PEAK_BF16, 4),
         "mfu_analytic": round(analytic_flops / dt / V5E_PEAK_BF16, 4),
         "compile_s": round(compile_s, 1),
         "peak_hbm_gb": _peak_hbm_gb(dev, jitted, hbm_args),
     }
+    if dp_mode:
+        # remat recompute is inside XLA's count: that ratio is HFU, not
+        # MFU — report model-FLOP mfu and the hardware count separately
+        # (the llama stage's convention)
+        rec.update({
+            "mfu": round(analytic_flops / dt / V5E_PEAK_BF16, 4),
+            "flops_per_round_xla_hw": xla_flops,
+            "hfu_xla": (round(xla_flops / dt / V5E_PEAK_BF16, 4)
+                        if xla_flops else None),
+            "dp": {"clip_norm": dp_cfg.clip_norm,
+                   "noise_multiplier": dp_cfg.noise_multiplier},
+            "remat": True,
+        })
+    else:
+        flops = xla_flops or analytic_flops
+        rec.update({
+            "flops_per_round_xla": xla_flops,
+            "mfu": round(flops / dt / V5E_PEAK_BF16, 4),
+        })
+    return rec
 
 
 # ======================================================================
@@ -931,6 +963,10 @@ def main() -> None:
                       {"BATON_SUITE_LLAMA_BATCH": "8"})
         elif stage == "vit":
             run_child([py, me, "--child", "vit"], 900, "vit")
+        elif stage == "vit_dp":
+            # config-5 shape: DP-SGD per-example clipped grads + remat
+            run_child([py, me, "--child", "vit"], 900, "vit_dp",
+                      {"BATON_SUITE_VIT_DP": "1"})
         elif stage == "wave1024":
             impl, bs = _conv_winner()
             # im2col's patch blowup may exceed HBM at large waves: the

@@ -6,11 +6,18 @@
 # Leave running in the background; it exits after one full pass.
 cd /root/repo
 LOG=/tmp/tpu_watch2.log
-bash benchmarks/tpu_watch.sh "$LOG"   # blocks until a probe answers
+bash benchmarks/tpu_watch.sh "$LOG" || exit 1   # blocks until a probe answers
+# the watch writes /tmp/tpu_alive ONLY on a live probe; if it was
+# killed or died, do not fall through and burn the stages on a dark
+# tunnel (observed: a stray kill of the watcher child did exactly that)
+if [ ! -e /tmp/tpu_alive ]; then
+  echo "[trigger] watcher exited without alive flag; aborting" >> "$LOG"
+  exit 1
+fi
 echo "[trigger] tunnel alive at $(date -u +%H:%M:%S); running stages" >> "$LOG"
 python benchmarks/r4_tpu_suite.py --stages headline >> /tmp/r4_suite_run2.log 2>&1
 python benchmarks/plan_probe.py >> benchmarks/plan_probe_tpu.jsonl 2>>"$LOG"
-python benchmarks/r4_tpu_suite.py --stages conv,headline_im2col,wave1024,wave1024_fused,wave128,attn,vit,bert_b64,llama_b8 >> /tmp/r4_suite_run2.log 2>&1
+python benchmarks/r4_tpu_suite.py --stages conv,headline_im2col,wave1024,wave1024_fused,wave128,attn,vit,vit_dp,bert_b64,llama_b8 >> /tmp/r4_suite_run2.log 2>&1
 echo "[trigger] full pass done at $(date -u +%H:%M:%S)" >> "$LOG"
 # Auto-commit the recorded artifacts: a live window at the end of the
 # session must not leave its measurements uncommitted (the driver

@@ -200,20 +200,27 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cohorts", default="16,64,128")
     args = ap.parse_args()
-    out = {
-        "note": ("all C clients' O(C) DH modexps run serialized in ONE "
-                 "container process; a real deployment spreads that "
-                 "per-client work across C hosts"),
-        "results": [],
-    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "secure_round_scale.json")
+    # merge-by-cohort, never clobber: a partial rerun (--cohorts 16)
+    # must not erase the other cohorts' recorded rows (it did once)
+    try:
+        with open(path) as f:
+            prior = {r["cohort"]: r for r in json.load(f)["results"]}
+    except (OSError, ValueError, KeyError, TypeError):
+        prior = {}
     for n in (int(x) for x in args.cohorts.split(",")):
         n_silent = max(1, n // 21)  # 16->1, 64->3, 128->6 dropouts
         rec = asyncio.new_event_loop().run_until_complete(
             _one_cohort(n, n_silent))
-        out["results"].append(rec)
+        prior[n] = rec
         print(json.dumps(rec), flush=True)
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "secure_round_scale.json")
+    out = {
+        "note": ("all C clients' O(C) DH modexps run serialized in ONE "
+                 "container process; a real deployment spreads that "
+                 "per-client work across C hosts"),
+        "results": [prior[k] for k in sorted(prior)],
+    }
     with open(path, "w") as f:
         json.dump(out, f, indent=2)
     print(f"wrote {path}", file=sys.stderr)
