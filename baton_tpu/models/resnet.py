@@ -72,6 +72,17 @@ def _conv_im2col(x, w, stride=1):
     dtype tolerance (tests/test_resnet.py).
     """
     kh, kw, cin, cout = w.shape
+    cols = [xs for _, _, xs in _shifted_views(x, kh, kw, stride)]
+    patches = jnp.concatenate(cols, axis=-1)  # [B, OH, OW, kh*kw*Cin]
+    wm = w.astype(x.dtype).reshape(kh * kw * cin, cout)
+    return jax.lax.dot_general(patches, wm, (((3,), (0,)), ((), ())))
+
+
+def _shifted_views(x, kh, kw, stride):
+    """Yield ``(i, j, shifted_view)`` for each kernel tap of a SAME
+    conv: the strided slice of the padded input that tap (i, j)
+    multiplies. Shared padding/slice arithmetic for the im2col and
+    shift-GEMM lowerings."""
     b, h, wd, _ = x.shape
     oh = -(-h // stride)
     ow = -(-wd // stride)
@@ -80,21 +91,49 @@ def _conv_im2col(x, w, stride=1):
     xp = jnp.pad(
         x, ((0, 0), (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0))
     )
-    cols = []
     for i in range(kh):
         for j in range(kw):
-            cols.append(
-                xp[:, i : i + (oh - 1) * stride + 1 : stride,
-                   j : j + (ow - 1) * stride + 1 : stride, :]
-            )
-    patches = jnp.concatenate(cols, axis=-1)  # [B, OH, OW, kh*kw*Cin]
-    wm = w.astype(x.dtype).reshape(kh * kw * cin, cout)
-    return jax.lax.dot_general(patches, wm, (((3,), (0,)), ((), ())))
+            yield i, j, xp[:, i : i + (oh - 1) * stride + 1 : stride,
+                           j : j + (ow - 1) * stride + 1 : stride, :]
+
+
+def _conv_shift(x, w, stride=1):
+    """SAME conv as a sum of kh*kw shifted plain matmuls
+    (``y = sum_ij shift(x, i, j) @ w[i, j]`` — the kn2row/shift-GEMM
+    decomposition).
+
+    Same motivation as :func:`_conv_im2col` (per-client weights under
+    vmap must lower to batched matmuls, not C-group grouped
+    convolutions) but WITHOUT im2col's kh*kw-fold patch
+    materialization: each term reads a shifted view of ``x`` and
+    contracts only over Cin, so peak activation HBM stays at the direct
+    conv's level (the im2col wave-32 kernel's 19.2 GiB static plan
+    exceeded the v5e's capacity — measured live, r4). The trade: kh*kw
+    matmuls with K = Cin instead of one with K = kh*kw*Cin — smaller
+    MXU tiles on the 64-channel stem, full-size from stage 2 on.
+
+    Numerics: per output element the same multiply-adds as the direct
+    conv, reassociated. The kh*kw partial products are accumulated in
+    fp32 regardless of compute dtype (``preferred_element_type``) — a
+    bf16 running sum would round at every inter-term add, drifting far
+    past reassociation noise — and cast back once at return. Pinned
+    against the direct conv in fp32 AND bf16 in tests/test_resnet.py.
+    """
+    kh, kw, _, _ = w.shape
+    wm = w.astype(x.dtype)
+    out = None
+    for i, j, xs in _shifted_views(x, kh, kw, stride):
+        term = jax.lax.dot_general(
+            xs, wm[i, j], (((3,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out = term if out is None else out + term
+    return out.astype(x.dtype)
 
 
 # module-level dispatch table so `conv_impl` stays a plain string in the
 # model factory signature (hashable, serializable into configs)
-_CONV_IMPLS = {"direct": _conv_direct, "im2col": _conv_im2col}
+_CONV_IMPLS = {"direct": _conv_direct, "im2col": _conv_im2col,
+               "shift": _conv_shift}
 
 
 def _conv(x, w, stride=1, impl="direct"):

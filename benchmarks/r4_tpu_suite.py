@@ -168,7 +168,8 @@ def child_conv() -> dict:
            "device_kind": getattr(dev, "device_kind", dev.platform),
            "clients": C, "batch": B, "layers": [], "full_model": {}}
 
-    from baton_tpu.models.resnet import _conv_direct, _conv_im2col
+    from baton_tpu.models.resnet import (_conv_direct, _conv_im2col,
+                                         _conv_shift)
 
     def conv_bgc(xs, ws, stride):
         """Per-client conv via batch_group_count: lhs [C*B,H,W,cin],
@@ -211,6 +212,8 @@ def child_conv() -> dict:
                 lambda x, w: _conv_direct(x, w, stride)),
             "vmap_im2col": jax.vmap(
                 lambda x, w: _conv_im2col(x, w, stride)),
+            "vmap_shift": jax.vmap(
+                lambda x, w: _conv_shift(x, w, stride)),
             "batch_group_count": lambda xs, ws: conv_bgc(xs, ws, stride),
         }
         for name, fn in strategies.items():
@@ -261,7 +264,7 @@ def child_conv() -> dict:
     # restructuring"). Identical FedAvg semantics, different SGD
     # batching — reported as separate configs.
     batch_sizes = (spc,) if SMOKE else (32, 48)
-    for impl in ("direct", "im2col"):
+    for impl in ("direct", "im2col", "shift"):
         model = (resnet_model(blocks_per_stage=(1,), n_groups=4,
                               conv_impl=impl)
                  if SMOKE else

@@ -142,3 +142,78 @@ def test_cnn_im2col_matches_direct(nprng):
     for gd, gi in zip(jax.tree_util.tree_leaves(grad_d),
                       jax.tree_util.tree_leaves(grad_i)):
         np.testing.assert_allclose(gi, gd, rtol=5e-4, atol=5e-4)
+
+
+def test_shift_conv_matches_direct():
+    """The shift-GEMM lowering (sum of kh*kw shifted plain matmuls —
+    batched-matmul MFU without im2col's kh*kw activation blowup) must be
+    numerically equivalent to lax.conv_general_dilated for every shape
+    the ResNet uses."""
+    from baton_tpu.models.resnet import _conv_direct, _conv_shift
+
+    key = jax.random.key(5)
+    for kh, cin, cout, stride, hw in [
+        (3, 3, 16, 1, 32),   # stem
+        (3, 16, 16, 1, 32),  # body
+        (3, 16, 32, 2, 32),  # strided stage entry
+        (1, 16, 32, 2, 32),  # strided 1x1 projection
+        (3, 8, 8, 2, 9),     # odd spatial size: SAME padding asymmetry
+        (7, 3, 16, 2, 33),   # imagenet stem shape
+    ]:
+        kx, kw_ = jax.random.split(jax.random.fold_in(key, kh * cin * stride))
+        x = jax.random.normal(kx, (2, hw, hw, cin), jnp.float32)
+        w = jax.random.normal(kw_, (kh, kh, cin, cout), jnp.float32)
+        ref = _conv_direct(x, w, stride)
+        got = _conv_shift(x, w, stride)
+        assert got.shape == ref.shape, (kh, cin, cout, stride, hw)
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_shift_resnet_vmapped_grads_match(nprng):
+    """Per-client vmapped value_and_grad is the same function under the
+    shift lowering (mirror of the im2col parity test)."""
+    m_direct = resnet_model(blocks_per_stage=(1,), n_classes=4, n_groups=4)
+    m_shift = resnet_model(blocks_per_stage=(1,), n_classes=4, n_groups=4,
+                           conv_impl="shift")
+    params = m_direct.init(jax.random.key(0))
+    x = jnp.asarray(nprng.normal(size=(3, 2, 8, 8, 3)), jnp.float32)
+    y = jnp.asarray(nprng.integers(0, 4, size=(3, 2)), jnp.int32)
+
+    def mean_loss(model, p, xb, yb):
+        return jnp.mean(model.per_example_loss(
+            p, {"x": xb, "y": yb}, jax.random.key(1)))
+
+    def per_client(model):
+        f = lambda p, xb, yb: jax.value_and_grad(
+            lambda pp: mean_loss(model, pp, xb, yb))(p)
+        return jax.vmap(f, in_axes=(None, 0, 0))(params, x, y)
+
+    loss_d, grad_d = per_client(m_direct)
+    loss_s, grad_s = per_client(m_shift)
+    np.testing.assert_allclose(loss_s, loss_d, rtol=1e-5, atol=1e-5)
+    for gd, gs in zip(jax.tree_util.tree_leaves(grad_d),
+                      jax.tree_util.tree_leaves(grad_s)):
+        np.testing.assert_allclose(gs, gd, rtol=5e-4, atol=5e-4)
+
+
+def test_shift_conv_bf16_accumulation():
+    """In the dtype the flagship actually trains in (bf16 compute),
+    shift-GEMM must match the direct conv to bf16-level tolerance: its
+    kh*kw partial products accumulate in fp32, so the only divergence
+    is the final-cast rounding, not 9 (or 49) compounding bf16 adds."""
+    from baton_tpu.models.resnet import _conv_direct, _conv_shift
+
+    key = jax.random.key(11)
+    for kh, cin, cout, stride, hw in [
+        (3, 64, 64, 1, 32),
+        (7, 3, 64, 2, 33),   # 49-tap imagenet stem: worst accumulation
+    ]:
+        kx, kw_ = jax.random.split(jax.random.fold_in(key, kh * cin))
+        x = jax.random.normal(kx, (2, hw, hw, cin), jnp.bfloat16)
+        w = jax.random.normal(kw_, (kh, kh, cin, cout), jnp.float32)
+        ref = np.asarray(_conv_direct(x, w, stride), np.float32)
+        got = np.asarray(_conv_shift(x, w, stride), np.float32)
+        # bf16 has ~2-3 decimal digits; both sides accumulate in fp32
+        # internally so they agree to one final-rounding ulp
+        scale = np.maximum(np.abs(ref), 1.0)
+        np.testing.assert_allclose(got / scale, ref / scale, atol=2e-2)
