@@ -4,6 +4,9 @@ flash kernel's default block sizes (ops/flash_attention.py).
 
 Writes benchmarks/attention_sweep_tpu.json (the committed artifact the
 dispatch threshold cites) in addition to the human-readable table.
+``models/transformer.py::configure_attention_dispatch(sweep_path=...)``
+applies the measured crossover + winning block shapes to the
+dispatcher directly from this artifact.
 
 Usage:  python benchmarks/attention_sweep.py [--lens 1024,2048,4096,8192] \
             [--blocks 256x256,512x512,512x1024] [--dense-max 4096]
