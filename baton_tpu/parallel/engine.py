@@ -347,6 +347,69 @@ class FedSim:
         return data, n_samples, rngs
 
     # ------------------------------------------------------------------
+    def auto_wave_size(self, params, data, n_samples, key=None,
+                       n_epochs: int = 1,
+                       budget_gb: Optional[float] = None) -> Optional[int]:
+        """Largest wave size whose XLA static memory plan fits the
+        device budget — the benchmark-side OOM guard productized: on a
+        tunneled/shared chip an out-of-memory execution can take the
+        accelerator down for hours, so size waves from the compiler's
+        own plan instead of trial-and-error. Compiles wave kernels
+        (cached persistently) but never executes them.
+
+        Returns ``None`` when the full cohort fits as one wave, else
+        the halved-until-it-fits wave size (a multiple of the wave
+        unit). Raises ``RuntimeError`` when no wave down to one wave
+        unit fits, and ``NotImplementedError`` for robust aggregators
+        (their per-client-params-stacking kernel has a different, much
+        larger footprint than the weighted-sums kernel this probes —
+        sizing from the wrong kernel would admit waves that OOM; set
+        wave_size explicitly there). When the backend surfaces no
+        memory analysis (some CPU configs), the full cohort is assumed
+        to fit — matching the pre-auto behavior. ``budget_gb``
+        overrides the per-device-kind plan budget
+        (profiling.hbm_budget_gb, conservative tier).
+
+        On a clients mesh the probe lowers the PER-SHARD program (each
+        device executes wave/n_dev clients under shard_map), so the
+        plan is compared against one device's budget."""
+        from baton_tpu.utils.profiling import (
+            fedsim_wave_plan_gb,
+            hbm_budget_gb,
+        )
+
+        if self.aggregator[0] != "mean":
+            raise NotImplementedError(
+                "auto_wave_size probes the weighted-sums wave kernel; "
+                f"aggregator={self.aggregator[0]!r} executes the "
+                "per-client-params-stacking kernel with a different "
+                "footprint — pass an explicit wave_size")
+        if budget_gb is None:
+            budget_gb = hbm_budget_gb(jax.devices()[0])
+        if key is None:
+            key = jax.random.key(0)
+        n_samples = jnp.asarray(n_samples)
+        unit = self._clients_per_wave_unit()
+        n_dev = unit  # clients mesh: one wave unit = one client per device
+        w = round_up(int(n_samples.shape[0]), unit)
+        while True:
+            # per-device footprint: each device runs a wave/n_dev-client
+            # program under shard_map
+            plan = fedsim_wave_plan_gb(
+                self, params, data, n_samples, key,
+                wave_size=max(1, w // n_dev), n_epochs=n_epochs)
+            if plan is None or plan <= budget_gb:
+                break
+            if w <= unit:
+                raise RuntimeError(
+                    f"no wave size down to {unit} fits the "
+                    f"{budget_gb:.1f} GiB plan budget (smallest plan "
+                    f"{plan:.1f} GiB) — shrink the per-client batch or "
+                    "dataset instead of risking an OOM")
+            w = round_up(max(unit, w // 2), unit)
+        full = round_up(int(n_samples.shape[0]), unit)
+        return None if w >= full else w
+
     def run_round(
         self,
         params: Params,
@@ -354,7 +417,7 @@ class FedSim:
         n_samples: jax.Array,
         rng: jax.Array,
         n_epochs: int = 1,
-        wave_size: Optional[int] = None,
+        wave_size=None,
         server_opt_state=None,
         client_indices: Optional[np.ndarray] = None,
         collect_client_losses: bool = True,
@@ -374,7 +437,12 @@ class FedSim:
         maximum-throughput runs, set it for long rounds that need
         mid-round visibility (reference utils.py:70-91 streamed
         progress; a multi-wave round is otherwise a black box).
+
+        ``wave_size="auto"`` sizes waves from XLA's static memory plan
+        (:meth:`auto_wave_size`); the decision is cached per cohort
+        shape, so repeated rounds pay the plan compiles once.
         """
+        orig_params = params
         params, frozen = self._split(params)
         n_samples = jnp.asarray(n_samples)
         if client_indices is not None:
@@ -385,6 +453,19 @@ class FedSim:
         rngs = jax.random.split(rng, c)
 
         n_dev = self._clients_per_wave_unit()
+        if wave_size == "auto":
+            cache_key = (
+                c, n_epochs,
+                tuple(sorted((k, v.shape, str(v.dtype))
+                             for k, v in data.items())),
+            )
+            cache = getattr(self, "_auto_wave_cache", None)
+            if cache is None:
+                cache = self._auto_wave_cache = {}
+            if cache_key not in cache:
+                cache[cache_key] = self.auto_wave_size(
+                    orig_params, data, n_samples, n_epochs=n_epochs)
+            wave_size = cache[cache_key]
         if wave_size is None:
             wave_size = round_up(c, n_dev)
         else:
@@ -796,7 +877,7 @@ class FedSim:
         rng: jax.Array,
         n_rounds: int,
         n_epochs: int = 1,
-        wave_size: Optional[int] = None,
+        wave_size=None,
         server_opt_state=None,
         return_server_opt_state: bool = False,
         donate_buffers: bool = False,
@@ -829,6 +910,12 @@ class FedSim:
                 f"the {self.aggregator[0]!r} aggregator; use run_round/"
                 "run_rounds for robust aggregation"
             )
+        if wave_size == "auto":
+            # the fused scan adds only params/opt/accumulator carries on
+            # top of the wave kernel auto probes — small next to the
+            # conservative plan budget
+            wave_size = self.auto_wave_size(params, data, n_samples,
+                                            n_epochs=n_epochs)
         params, frozen = self._split(params)
         n_samples = jnp.asarray(n_samples)
         c = int(n_samples.shape[0])

@@ -122,16 +122,23 @@ def _lower_wave_kernel(sim, params, data, n_samples, key,
                        wave_size: Optional[int] = None, n_epochs: int = 1):
     """(jitted, args) for ONE wave of ``sim``'s round, honoring a
     trainable/frozen partition — the program whose memory plan stands in
-    for the round's footprint."""
+    for the round's footprint. A ``wave_size`` larger than the cohort is
+    PADDED to size (run_round pads its last wave the same way) — slicing
+    alone would hand vmap mismatched leading axes, and the resulting
+    trace error must not read as "no analysis, assume it fits"."""
     import jax
     import jax.numpy as jnp
 
     tr, fz = sim._split(params)
     n_samples = jnp.asarray(n_samples)
-    w = wave_size or int(n_samples.shape[0])
-    d0 = jax.tree_util.tree_map(lambda a: a[:w], data)
-    n0 = n_samples[:w]
-    r0 = jax.random.split(key, w)
+    c = int(n_samples.shape[0])
+    w = wave_size or c
+    take = min(w, c)
+    d0 = jax.tree_util.tree_map(lambda a: a[:take], data)
+    n0 = n_samples[:take]
+    r0 = jax.random.split(key, take)
+    if take < w:
+        d0, n0, r0 = sim._pad_wave(d0, n0, r0, w)
     jitted = jax.jit(lambda a, b, d, n, r: sim._wave_sums_raw(
         a, b, d, n, r, n_epochs))
     return jitted, (tr, fz, d0, n0, r0)

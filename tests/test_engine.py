@@ -288,3 +288,81 @@ def test_evaluate_clients_fairness(linear_setup):
     assert f["worst"] == float(np.nanmax(pc["loss"]))
     assert f["worst_decile"] <= f["worst"]
     assert f["worst"] >= f["mean"]
+
+
+def test_auto_wave_size_from_memory_plan(nprng):
+    """wave_size="auto" productizes the OOM guard: the wave size comes
+    from XLA's static memory plan vs the device budget, halving until
+    it fits, with per-shape caching on the run_round path."""
+    from baton_tpu.models.linear import linear_regression_model
+    from baton_tpu.ops.padding import stack_client_datasets
+
+    model = linear_regression_model(6)
+    datasets = [{
+        "x": nprng.normal(size=(8, 6)).astype(np.float32),
+        "y": nprng.normal(size=(8,)).astype(np.float32),
+    } for _ in range(8)]
+    data, n = stack_client_datasets(datasets, batch_size=8)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    sim = FedSim(model, batch_size=8, learning_rate=0.1)
+    params = sim.init(jax.random.key(0))
+
+    # a generous budget: the whole cohort fits in one wave
+    assert sim.auto_wave_size(params, data, n, budget_gb=64.0) is None
+
+    # a budget under the full-cohort plan but above the halved plans:
+    # auto must halve at least once and return a smaller wave
+    from baton_tpu.utils.profiling import fedsim_wave_plan_gb
+
+    full_plan = fedsim_wave_plan_gb(sim, params, data, jnp.asarray(n),
+                                    jax.random.key(0))
+    if full_plan is not None:  # CPU surfaces memory analysis today
+        w = sim.auto_wave_size(params, data, n,
+                               budget_gb=full_plan * 0.9)
+        assert w is not None and w < 8
+
+    # nothing fits: refuse rather than risk the OOM (only assertable
+    # where the backend surfaces memory analysis at all)
+    if full_plan is not None:
+        with pytest.raises(RuntimeError, match="no wave size"):
+            sim.auto_wave_size(params, data, n, budget_gb=1e-12)
+
+    # robust aggregators execute a different (params-stacking) kernel:
+    # sizing from the sums kernel would lie, so auto refuses
+    sim_robust = FedSim(model, batch_size=8, learning_rate=0.1,
+                        aggregator="median")
+    with pytest.raises(NotImplementedError, match="wave_size"):
+        sim_robust.auto_wave_size(params, data, n, budget_gb=64.0)
+
+    # end-to-end through run_round, decision cached per cohort shape
+    res = sim.run_round(params, data, jnp.asarray(n), jax.random.key(1),
+                        wave_size="auto")
+    assert np.isfinite(float(res.loss_history[-1]))
+    assert len(sim._auto_wave_cache) == 1
+    sim.run_round(res.params, data, jnp.asarray(n), jax.random.key(2),
+                  wave_size="auto")
+    assert len(sim._auto_wave_cache) == 1  # same shapes -> cache hit
+
+
+def test_auto_wave_size_mesh_and_fused(nprng):
+    """"auto" composes with a clients mesh (the probe lowers the
+    per-shard program) and with run_rounds_fused."""
+    from baton_tpu.models.linear import linear_regression_model
+    from baton_tpu.ops.padding import stack_client_datasets
+    from baton_tpu.parallel.mesh import make_mesh
+
+    model = linear_regression_model(6)
+    datasets = [{
+        "x": nprng.normal(size=(8, 6)).astype(np.float32),
+        "y": nprng.normal(size=(8,)).astype(np.float32),
+    } for _ in range(16)]
+    data, n = stack_client_datasets(datasets, batch_size=8)
+    data = {k: jnp.asarray(v) for k, v in data.items()}
+    sim = FedSim(model, batch_size=8, learning_rate=0.1, mesh=make_mesh(8))
+    params = sim.init(jax.random.key(0))
+
+    assert sim.auto_wave_size(params, data, n, budget_gb=64.0) is None
+    p2, hist = sim.run_rounds_fused(params, data, jnp.asarray(n),
+                                    jax.random.key(1), n_rounds=2,
+                                    wave_size="auto")
+    assert np.isfinite(float(hist[-1]))
