@@ -973,64 +973,84 @@ class Experiment:
                 self.rounds.abort_round()
                 self._secure_round = None
                 return
-            corrections = []
-            for d in dropped:
-                c_sk = secure.shamir_reconstruct(
-                    dict(list(csk_shares[d].items())[:t])
-                )
-                seeds = {
-                    rid: secure.dh_shared_seed(
-                        c_sk, sr["c_pks"][rid], sr["round_name"]
+            # Reconstruction + mask regeneration + the modular sum are
+            # the round's heaviest host compute — O(dropped×survivors)
+            # 2048-bit modexps plus O(C) Philox masks over the full
+            # template (measured: ~20 s at 128 members with 32 dropped).
+            # Run it all in a worker thread: on the loop it starved
+            # heartbeats/uploads for every co-located client (the r4
+            # secure_round_scale run recorded 26 starvation dropouts).
+            # Aggregate the SNAPSHOTTED reporter set, not whatever lands
+            # in the round state meanwhile: a straggler in `dropped`
+            # that uploaded during an await window would otherwise be
+            # counted in the sum while its masks are also 'corrected' —
+            # leaving uncancelled mask noise in the params.
+            # (handle_update additionally 410s those stragglers; this
+            # is the backstop.)
+            reports = list(reporters.values())
+
+            def _reconstruct_and_open():
+                corrections = []
+                for d in dropped:
+                    c_sk = secure.shamir_reconstruct(
+                        dict(list(csk_shares[d].items())[:t])
                     )
-                    for rid in survivors
-                }
+                    seeds = {
+                        rid: secure.dh_shared_seed(
+                            c_sk, sr["c_pks"][rid], sr["round_name"]
+                        )
+                        for rid in survivors
+                    }
+                    corrections.append(
+                        secure.dropout_correction(d, seeds, template)
+                    )
+                    # the reconstructed key exists only to cancel this
+                    # round's residues — purge its cached DH powers so
+                    # the dropped client's pairwise secrets don't
+                    # outlive the finalization (secure.py
+                    # forward-secrecy contract)
+                    secure.purge_dh_secrets(c_sk)
+                self_seeds = []
+                for s_cid in survivors:
+                    b_int = secure.shamir_reconstruct(
+                        dict(list(b_shares[s_cid].items())[:t])
+                    )
+                    if b_int >> 256:
+                        # a corrupt share makes the interpolation land
+                        # almost surely outside the 256-bit seed range —
+                        # the sum cannot be opened correctly
+                        return None
+                    self_seeds.append(b_int.to_bytes(32, "big"))
                 corrections.append(
-                    secure.dropout_correction(d, seeds, template)
+                    secure.self_mask_correction(self_seeds, template)
                 )
-                # the reconstructed key exists only to cancel this
-                # round's residues — purge its cached DH powers so the
-                # dropped client's pairwise secrets don't outlive the
-                # finalization (secure.py forward-secrecy contract)
-                secure.purge_dh_secrets(c_sk)
-            self_seeds = []
-            for s_cid in survivors:
-                b_int = secure.shamir_reconstruct(
-                    dict(list(b_shares[s_cid].items())[:t])
+                masked_sum = secure.modular_sum(
+                    [r["state_dict"] for r in reports]
                 )
-                if b_int >> 256:
-                    # a corrupt share makes the interpolation land almost
-                    # surely outside the 256-bit seed range — the sum
-                    # cannot be opened correctly; abort, don't crash the
-                    # finalize task (which would lock the round forever)
-                    self.metrics.inc("secure_rounds_unrecoverable")
-                    self.rounds.abort_round()
-                    self._secure_round = None
-                    return
-                self_seeds.append(b_int.to_bytes(32, "big"))
-            corrections.append(
-                secure.self_mask_correction(self_seeds, template)
-            )
+                return secure.unmask_sum(
+                    masked_sum, corrections, sr["scale_bits"]
+                )
+
+            total = await asyncio.to_thread(_reconstruct_and_open)
             if not self.rounds.in_progress or self.rounds.round_name != sr["round_name"]:
-                return  # round was aborted while unmasking was in flight
+                # the round was aborted (or a NEW round started) while
+                # the reconstruction thread ran — in either case this
+                # finalization owns nothing anymore and must not touch
+                # the current round's state
+                return
+            if total is None:
+                # abort, don't crash the finalize task (which would
+                # lock the round forever)
+                self.metrics.inc("secure_rounds_unrecoverable")
+                self.rounds.abort_round()
+                self._secure_round = None
+                return
             if dropped:
                 self.metrics.inc("secure_dropouts_recovered", len(dropped))
             n_epoch = (self.rounds.round_meta or {}).get("n_epoch", 0)
             self.metrics.observe("round_s", self.rounds.elapsed)
             self.rounds.end_round()
             self.metrics.inc("rounds_finished")
-            # Aggregate the SNAPSHOTTED reporter set, not whatever landed
-            # in the round state since: a straggler in `dropped` that
-            # uploaded during the reveal await window would otherwise be
-            # counted in the sum while its masks are also 'corrected' —
-            # leaving uncancelled mask noise in the params. (handle_update
-            # additionally 410s those stragglers; this is the backstop.)
-            reports = list(reporters.values())
-            masked_sum = secure.modular_sum(
-                [r["state_dict"] for r in reports]
-            )
-            total = secure.unmask_sum(
-                masked_sum, corrections, sr["scale_bits"]
-            )
             w = sum(float(r["n_samples"]) for r in reports)
             if w > 0:
                 merged = {k: v / w for k, v in total.items()}

@@ -131,6 +131,11 @@ class ExperimentWorker:
         self.key: Optional[str] = None
         self.n_updates = 0
         self.round_in_progress = False
+        # guards the broadcast handler's await windows (body read, boxed-
+        # share decryption in a worker thread): a duplicate round_start
+        # arriving mid-handler must 409 exactly like one arriving
+        # mid-training, or two training tasks would stack (§2.9 item 5)
+        self._broadcast_busy = False
         self.last_update: Optional[str] = None
         self.heartbeat_time = heartbeat_time
         self._heartbeat_task: Optional[PeriodicTask] = None
@@ -240,8 +245,11 @@ class ExperimentWorker:
 
         data = await request.json()
         round_name = str(data["round"])
-        c_sk, c_pk = secure.dh_keypair()
-        s_sk, s_pk = secure.dh_keypair()
+        # two 2048-bit modexps (~14 ms): off the loop — with C cohort
+        # members sharing one process (tests, benchmarks, co-located
+        # silos) the serialized key generations alone starve heartbeats
+        (c_sk, c_pk), (s_sk, s_pk) = await asyncio.to_thread(
+            lambda: (secure.dh_keypair(), secure.dh_keypair()))
         self._secure[round_name] = {
             "c_sk": c_sk, "c_pk": c_pk, "s_sk": s_sk, "s_pk": s_pk,
             "peer_shares": {}, "partition": None,
@@ -285,31 +293,43 @@ class ExperimentWorker:
             # and c_sk_i by itself — refuse anything below honest majority
             return web.json_response({"err": "Threshold Too Low"}, status=400)
         index = {cid: x + 1 for x, cid in enumerate(cohort)}
-        b_seed = secrets.token_bytes(32)
-        b_shares = secure.shamir_share(
-            int.from_bytes(b_seed, "big"), len(cohort), t
-        )
-        csk_shares = secure.shamir_share(st["c_sk"], len(cohort), t)
-        boxes = {}
-        for cid in cohort:
-            if cid == self.client_id:
-                continue
-            # direction-bound key: without the sender->recipient context
-            # the pair's two boxes would share one nonce-free keystream
-            # (a two-time pad to the relaying server) and a reflected box
-            # would still authenticate
-            try:
-                key = secure.dh_shared_seed(
-                    st["s_sk"], pks[cid][1],
-                    f"{round_name}|shares|{self.client_id}>{cid}",
-                )
-            except ValueError:
-                continue  # Byzantine pk: skip this peer, not the round
-            plain = (
-                secure.share_to_hex(b_shares[index[cid]])
-                + secure.share_to_hex(csk_shares[index[cid]])
-            ).encode()
-            boxes[cid] = secure.seal(key, plain).hex()
+
+        # O(C) 2048-bit modexps (~7 ms each — the protocol's dominant
+        # host cost) plus the Shamir splits and box sealing: run the
+        # whole block off the event loop. At C=128 this block is ~1 s;
+        # serialized across a co-located cohort it starved heartbeats
+        # and uploads for minutes (26 unplanned dropouts in the r4
+        # secure_round_scale run).
+        def _build_boxes():
+            b_seed = secrets.token_bytes(32)
+            b_shares = secure.shamir_share(
+                int.from_bytes(b_seed, "big"), len(cohort), t
+            )
+            csk_shares = secure.shamir_share(st["c_sk"], len(cohort), t)
+            boxes = {}
+            for cid in cohort:
+                if cid == self.client_id:
+                    continue
+                # direction-bound key: without the sender->recipient
+                # context the pair's two boxes would share one
+                # nonce-free keystream (a two-time pad to the relaying
+                # server) and a reflected box would still authenticate
+                try:
+                    key = secure.dh_shared_seed(
+                        st["s_sk"], pks[cid][1],
+                        f"{round_name}|shares|{self.client_id}>{cid}",
+                    )
+                except ValueError:
+                    continue  # Byzantine pk: skip this peer, not the round
+                plain = (
+                    secure.share_to_hex(b_shares[index[cid]])
+                    + secure.share_to_hex(csk_shares[index[cid]])
+                ).encode()
+                boxes[cid] = secure.seal(key, plain).hex()
+            return b_seed, b_shares, csk_shares, boxes
+
+        b_seed, b_shares, csk_shares, boxes = await asyncio.to_thread(
+            _build_boxes)
         st.update(
             pks=pks, cohort=cohort, index=index, t=t, b=b_seed,
             own_shares=(
@@ -379,7 +399,7 @@ class ExperimentWorker:
 
     # -- rounds --------------------------------------------------------
     async def handle_round_start(self, request: web.Request) -> web.Response:
-        if self.round_in_progress:
+        if self.round_in_progress or self._broadcast_busy:
             return web.json_response({"err": "Update in Progress"}, status=409)
         if (
             request.query.get("client_id") != self.client_id
@@ -387,6 +407,15 @@ class ExperimentWorker:
         ):
             asyncio.ensure_future(self.register_with_manager())
             return web.json_response({"err": "Wrong Client"}, status=404)
+        self._broadcast_busy = True
+        try:
+            return await self._handle_round_start_locked(request)
+        finally:
+            self._broadcast_busy = False
+
+    async def _handle_round_start_locked(
+        self, request: web.Request
+    ) -> web.Response:
         body = await request.read()
         try:
             tensors, meta = wire.decode_any(
@@ -423,25 +452,35 @@ class ExperimentWorker:
                 return web.json_response({"err": "Bad Cohort"}, status=400)
             st["mask_cohort"] = mask_cohort
             st["scale_bits"] = int(secure_info.get("scale_bits", 16))
+
             # decrypt the share boxes relayed via the manager; a box
             # failing authentication just leaves that sender's shares
-            # missing (reconstruction needs only t of n)
-            for sender, ct_hex in dict(secure_info.get("inbox", {})).items():
-                if sender == self.client_id or sender not in st["pks"]:
-                    continue
-                try:
-                    key = _secure.dh_shared_seed(
-                        st["s_sk"], st["pks"][sender][1],
-                        f"{round_name}|shares|{sender}>{self.client_id}",
-                    )
-                    plain = _secure.unseal(key, bytes.fromhex(ct_hex)).decode()
-                    half = len(plain) // 2
-                    st["peer_shares"][sender] = (
-                        _secure.share_from_hex(plain[:half]),
-                        _secure.share_from_hex(plain[half:]),
-                    )
-                except (ValueError, UnicodeDecodeError):
-                    pass
+            # missing (reconstruction needs only t of n). O(C) modexps
+            # again — off the loop, same starvation argument as
+            # handle_secure_shares.
+            def _open_inbox():
+                opened = {}
+                for sender, ct_hex in dict(
+                        secure_info.get("inbox", {})).items():
+                    if sender == self.client_id or sender not in st["pks"]:
+                        continue
+                    try:
+                        key = _secure.dh_shared_seed(
+                            st["s_sk"], st["pks"][sender][1],
+                            f"{round_name}|shares|{sender}>{self.client_id}",
+                        )
+                        plain = _secure.unseal(
+                            key, bytes.fromhex(ct_hex)).decode()
+                        half = len(plain) // 2
+                        opened[sender] = (
+                            _secure.share_from_hex(plain[:half]),
+                            _secure.share_from_hex(plain[half:]),
+                        )
+                    except (ValueError, UnicodeDecodeError):
+                        pass
+                return opened
+
+            st["peer_shares"].update(await asyncio.to_thread(_open_inbox))
         self.params = new_params
         # the broadcast is this round's delta anchor: the manager holds
         # the identical tensors until end_round, so `anchor + delta`
@@ -540,24 +579,32 @@ class ExperimentWorker:
             # masked ring element.
             from baton_tpu.server import secure
 
-            seeds = {
-                other: secure.dh_shared_seed(
-                    st["c_sk"], st["pks"][other][0], round_name
+            # O(C) seed modexps + O(C) Philox masks over the full state
+            # dict — by far the heaviest per-upload host work in a
+            # secure round. Off the loop (same starvation argument as
+            # handle_secure_shares); numpy mask generation also releases
+            # the GIL, so co-located cohorts overlap it.
+            def _build_masked_body():
+                seeds = {
+                    other: secure.dh_shared_seed(
+                        st["c_sk"], st["pks"][other][0], round_name
+                    )
+                    for other in st["mask_cohort"]
+                    if other != self.client_id
+                }
+                weighted = {
+                    k: np.asarray(v, np.float64) * float(n_samples)
+                    for k, v in params_to_state_dict(self.params).items()
+                }
+                return wire.encode(
+                    secure.mask_state_dict(
+                        weighted, self.client_id, seeds, st["scale_bits"],
+                        self_seed=st["b"],
+                    ),
+                    dict(meta, secure=True, scale_bits=st["scale_bits"]),
                 )
-                for other in st["mask_cohort"]
-                if other != self.client_id
-            }
-            weighted = {
-                k: np.asarray(v, np.float64) * float(n_samples)
-                for k, v in params_to_state_dict(self.params).items()
-            }
-            body = wire.encode(
-                secure.mask_state_dict(
-                    weighted, self.client_id, seeds, st["scale_bits"],
-                    self_seed=st["b"],
-                ),
-                dict(meta, secure=True, scale_bits=st["scale_bits"]),
-            )
+
+            body = await asyncio.to_thread(_build_masked_body)
         elif self.compressor is not None and self._round_anchor is not None:
             # sparse round delta (ops/compression.py): top-k of
             # (trained - broadcast) with error feedback; flat wire layout

@@ -55,11 +55,14 @@ def timed(fn: Callable, *args: Any, **kwargs: Any) -> Tuple[Any, float]:
 
 def configure_jax_for_bench() -> None:
     """Shared benchmark-process JAX setup (bench.py / wave_sweep.py /
-    r4_tpu_suite.py / plan_probe.py): honor an explicit
+    tpu_suite.py / plan_probe.py): honor an explicit
     ``JAX_PLATFORMS=cpu`` request through ``jax.config`` (env-var
     overrides are unreliable against the axon plugin this container
-    registers at interpreter startup) and enable the persistent
-    compilation cache so retries and probes reuse compiles."""
+    registers at interpreter startup), enable the persistent
+    compilation cache so retries and probes reuse compiles, and apply
+    the committed hardware attention sweep (when one exists) to the
+    flash-vs-dense dispatcher — without this call the measured
+    crossover artifact would be inert (r4 advisor finding)."""
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     jax.config.update(
@@ -67,6 +70,19 @@ def configure_jax_for_bench() -> None:
         os.environ.get("JAX_COMPILATION_CACHE_DIR",
                        "/tmp/baton_tpu_jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # repo root = …/baton_tpu/utils/profiling.py -> three dirnames up
+    sweep = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "benchmarks", "attention_sweep_tpu.json")
+    if os.path.exists(sweep):
+        try:
+            from baton_tpu.models.transformer import (
+                configure_attention_dispatch)
+
+            configure_attention_dispatch(sweep_path=sweep)
+        except Exception:
+            pass  # a malformed artifact must never kill a bench run
 
 
 def is_oom_error(e: Exception) -> bool:
@@ -75,10 +91,25 @@ def is_oom_error(e: Exception) -> bool:
     COMPILE time with RESOURCE_EXHAUSTED and an allocation breakdown —
     that is a definitive "over budget", not an "analysis unavailable"
     (observed live on the tunneled v5e, round 4: the conv-shootout
-    im2col wave kernel)."""
+    im2col wave kernel).
+
+    A bare RESOURCE_EXHAUSTED is NOT enough: gRPC/transport reuse the
+    same status for quota, rate-limit, and message-size failures, and
+    classifying one of those as a device OOM turns a retryable flake
+    into a definitive plan=inf skip (and makes bench.py refuse its one
+    transient retry). Require corroborating memory/compile evidence —
+    every genuine TPU OOM observed on this tunnel carried it ("memory
+    space hbm", "Ran out of memory", an allocation breakdown, or the
+    remote_compile helper path that only 500s on compile failures)."""
     msg = str(e).lower()
-    return ("resource_exhausted" in msg or "out of memory" in msg
-            or "allocation type: hlo temp" in msg)
+    if "out of memory" in msg or "allocation type: hlo temp" in msg:
+        return True
+    if "resource_exhausted" not in msg:
+        return False
+    return any(s in msg for s in (
+        "hbm", "out of memory", "memory space", "allocation",
+        "ran out of", "tpu compile", "remote_compile",
+    ))
 
 
 def plan_breakdown_gb(jitted, args) -> dict:
@@ -211,6 +242,28 @@ ANCHORED_DIRECT_CONV_BUDGET_GB = {
     "TPU v5e": 17.5,
 }
 
+# The exact kernel identity the r3 hardware anchor covers: the direct-
+# lowering ResNet wave kernel at per-client batch 32 (wave_sweep_tpu.json
+# b32/spc48 wave-64, plan 17.42 GiB, EXECUTED at 0.942 rounds/s). The
+# plan-overcount evidence extends no further — a direct_b48 kernel is a
+# different program whose 16-17.5 GiB plan could be a real over-HBM
+# demand, and executing one is the multi-hour-outage scenario.
+ANCHORED_CONV_KERNEL = {"impl": "direct", "batch_size": 32}
+
+
+def conv_kernel_class(impl: str, batch_size: int = 32) -> str:
+    """OOM-guard kernel class for a per-client-conv wave kernel.
+
+    Returns ``"anchored_direct_conv"`` only for the FULL anchored
+    kernel identity (lowering impl AND per-client batch size matching
+    :data:`ANCHORED_CONV_KERNEL`); every other conv config — im2col,
+    shift, or an unanchored direct batch — gets the conservative
+    ``"default"`` tier."""
+    if (impl == ANCHORED_CONV_KERNEL["impl"]
+            and int(batch_size) == ANCHORED_CONV_KERNEL["batch_size"]):
+        return "anchored_direct_conv"
+    return "default"
+
 
 def hbm_budget_gb(device, kernel_class: str = "default") -> float:
     """Plan-space OOM-guard budget for ``device``.
@@ -262,7 +315,7 @@ def fedsim_wave_hbm(device, sim, params, data, n_samples, key,
     ``remaining_s`` is given the fallback is skipped below a 60 s floor
     — a slow tunnel compile must never turn an already-measured
     benchmark into a timeout. Single shared implementation for
-    bench.py / wave_sweep.py / r4_tpu_suite.py.
+    bench.py / wave_sweep.py / tpu_suite.py.
     """
     gb, src = peak_hbm_gb(device)
     if gb is not None:

@@ -340,8 +340,8 @@ def test_conv_winner_ignores_smoke_and_failed_records(tmp_path):
     import pathlib
 
     suite_path = (pathlib.Path(__file__).resolve().parent.parent
-                  / "benchmarks" / "r4_tpu_suite.py")
-    spec = importlib.util.spec_from_file_location("r4_suite", suite_path)
+                  / "benchmarks" / "tpu_suite.py")
+    spec = importlib.util.spec_from_file_location("tpu_suite_ut", suite_path)
     suite = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(suite)
 
@@ -390,6 +390,49 @@ def test_hbm_budget_device_mapping():
     # no anchor recorded for other generations: overlay falls through
     assert hbm_budget_gb(D("TPU v4"), "anchored_direct_conv") == 29.0
     assert hbm_budget_gb(D("weird"), "anchored_direct_conv") == 13.5
+
+
+def test_conv_kernel_class_keys_full_anchor_identity():
+    """The anchored plan-overcount overlay is evidence about ONE kernel
+    (direct lowering, per-client batch 32 — the r3-executed wave-64
+    program). Any other identity — a different batch, a different
+    lowering — must get the conservative tier: an unanchored direct_b48
+    config with a 17 GiB plan could be a REAL over-HBM demand (r4
+    advisor medium finding)."""
+    from baton_tpu.utils.profiling import conv_kernel_class
+
+    assert conv_kernel_class("direct", 32) == "anchored_direct_conv"
+    assert conv_kernel_class("direct", 48) == "default"
+    assert conv_kernel_class("im2col", 32) == "default"
+    assert conv_kernel_class("shift", 32) == "default"
+    assert conv_kernel_class("im2col", 48) == "default"
+
+
+def test_is_oom_error_requires_memory_corroboration():
+    """gRPC/transport reuse RESOURCE_EXHAUSTED for quota, rate-limit and
+    message-size failures; classifying those as device OOM turns a
+    retryable flake into a definitive plan=inf skip (r4 advisor
+    finding). Genuine TPU OOMs always carry memory/compile evidence."""
+    from baton_tpu.utils.profiling import is_oom_error
+
+    genuine = [
+        RuntimeError("RESOURCE_EXHAUSTED: XLA:TPU compile permanent "
+                     "error. Ran out of memory in memory space hbm"),
+        RuntimeError("remote_compile: HTTP 500: RESOURCE_EXHAUSTED"),
+        RuntimeError("Allocation type: HLO temp; Size: 256.00M"),
+        RuntimeError("out of memory allocating 123 bytes"),
+    ]
+    for e in genuine:
+        assert is_oom_error(e), e
+    transport = [
+        RuntimeError("RESOURCE_EXHAUSTED: received message larger than "
+                     "max (20971520 vs. 4194304)"),
+        RuntimeError("RESOURCE_EXHAUSTED: quota exceeded for requests"),
+        RuntimeError("RESOURCE_EXHAUSTED: rate limit"),
+        RuntimeError("tracing error"),
+    ]
+    for e in transport:
+        assert not is_oom_error(e), e
 
 
 def test_plan_gb_treats_compile_oom_as_infinite():
