@@ -960,6 +960,12 @@ class Experiment:
                             csk_shares[cid][x] = secure.share_from_hex(str(h))
                 except (KeyError, ValueError, TypeError):
                     continue
+            if self._secure_round is not sr or not self.rounds.in_progress:
+                # round replaced/aborted during the unmask HTTP
+                # round-trips — same ownership rule as after the
+                # reconstruction thread below (identity, not name:
+                # aborted rounds reuse their name)
+                return
             t = sr["t"]
             short = [
                 cid
@@ -1032,11 +1038,14 @@ class Experiment:
                 )
 
             total = await asyncio.to_thread(_reconstruct_and_open)
-            if not self.rounds.in_progress or self.rounds.round_name != sr["round_name"]:
+            if self._secure_round is not sr or not self.rounds.in_progress:
                 # the round was aborted (or a NEW round started) while
                 # the reconstruction thread ran — in either case this
                 # finalization owns nothing anymore and must not touch
-                # the current round's state
+                # the current round's state. Identity (`is sr`), not the
+                # round name: aborted rounds REUSE their name (reference
+                # naming parity, rounds.py::abort_round), so a replacement
+                # round is indistinguishable by name alone.
                 return
             if total is None:
                 # abort, don't crash the finalize task (which would

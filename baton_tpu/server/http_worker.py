@@ -228,7 +228,11 @@ class ExperimentWorker:
         )
 
     def _secure_state(self, round_name: str):
-        return self._secure.get(round_name)
+        st = self._secure.get(round_name)
+        # a pending claim (keys still being generated in the thread
+        # pool) is not usable state: shares/unmask against it would
+        # KeyError mid-protocol
+        return None if st is None or st.get("pending") else st
 
     async def handle_secure_keys(self, request: web.Request) -> web.Response:
         """Bonawitz round 0 (AdvertiseKeys): generate the round's two DH
@@ -245,20 +249,33 @@ class ExperimentWorker:
 
         data = await request.json()
         round_name = str(data["round"])
+        # claim the round slot BEFORE the thread window (loop-atomic):
+        # aborted rounds reuse names, so a stale delayed handler must be
+        # detectable by state identity — exactly the manager-side
+        # finalization rule — or it would overwrite a replacement
+        # round's keys and desynchronize the whole cohort's masks
+        st = {"pending": True, "peer_shares": {}, "partition": None}
+        self._secure[round_name] = st
+        while len(self._secure) > 2:  # keep current + previous round
+            old = self._secure.pop(next(iter(self._secure)))
+            # forward secrecy: evicting a round's keys must also drop
+            # the cached DH powers derived from them (secure.py);
+            # a pending claim has no keys yet
+            secure.purge_dh_secrets(
+                *[k for k in (old.get("c_sk"), old.get("s_sk"))
+                  if k is not None])
         # two 2048-bit modexps (~14 ms): off the loop — with C cohort
         # members sharing one process (tests, benchmarks, co-located
         # silos) the serialized key generations alone starve heartbeats
         (c_sk, c_pk), (s_sk, s_pk) = await asyncio.to_thread(
             lambda: (secure.dh_keypair(), secure.dh_keypair()))
-        self._secure[round_name] = {
-            "c_sk": c_sk, "c_pk": c_pk, "s_sk": s_sk, "s_pk": s_pk,
-            "peer_shares": {}, "partition": None,
-        }
-        while len(self._secure) > 2:  # keep current + previous round
-            old = self._secure.pop(next(iter(self._secure)))
-            # forward secrecy: evicting a round's keys must also drop
-            # the cached DH powers derived from them (secure.py)
-            secure.purge_dh_secrets(old["c_sk"], old["s_sk"])
+        if self._secure.get(round_name) is not st:
+            # a replacement round advertised keys while this handler
+            # sat in the thread pool: ours are stale — drop them
+            secure.purge_dh_secrets(c_sk, s_sk)
+            return web.json_response({"err": "Superseded"}, status=409)
+        st.update(c_sk=c_sk, c_pk=c_pk, s_sk=s_sk, s_pk=s_pk)
+        del st["pending"]
         return web.json_response({"c_pk": f"{c_pk:x}", "s_pk": f"{s_pk:x}"})
 
     async def handle_secure_shares(self, request: web.Request) -> web.Response:
@@ -330,6 +347,11 @@ class ExperimentWorker:
 
         b_seed, b_shares, csk_shares, boxes = await asyncio.to_thread(
             _build_boxes)
+        if self._secure_state(round_name) is not st:
+            # the round was re-keyed (same name — aborted rounds reuse
+            # names) while the boxes were being built: these shares are
+            # bound to dead keys and must not clobber the new state
+            return web.json_response({"err": "Superseded"}, status=409)
         st.update(
             pks=pks, cohort=cohort, index=index, t=t, b=b_seed,
             own_shares=(

@@ -124,19 +124,29 @@ def dh_keypair() -> Tuple[int, int]:
 # call :func:`purge_dh_secrets` when they discard a round's secure state
 # (worker key rotation, manager round finalization/abort) — a plain dict
 # with targeted eviction, NOT an lru_cache that would retain old rounds'
-# shared secrets for the process lifetime.
+# shared secrets for the process lifetime. Guarded by a lock: callers
+# run on asyncio worker THREADS (the event-loop starvation fix moved
+# all heavy crypto off-loop), so purge's iterate-and-delete can race a
+# concurrent insert/clear — "dict changed size during iteration" inside
+# a finalize task would leave a round locked forever. The 7 ms modexp
+# itself runs OUTSIDE the lock so threads don't serialize on it.
+import threading as _threading
+
 _DH_CACHE: Dict[Tuple[int, int], bytes] = {}
 _DH_CACHE_MAX = 16384
+_DH_CACHE_LOCK = _threading.Lock()
 
 
 def _dh_raw(sk: int, pk_other: int) -> bytes:
     key = (sk, pk_other)
-    v = _DH_CACHE.get(key)
+    with _DH_CACHE_LOCK:
+        v = _DH_CACHE.get(key)
     if v is None:
         v = pow(pk_other, sk, MODP_P).to_bytes(256, "big")
-        if len(_DH_CACHE) >= _DH_CACHE_MAX:
-            _DH_CACHE.clear()  # hard bound; entries are round-scoped
-        _DH_CACHE[key] = v
+        with _DH_CACHE_LOCK:
+            if len(_DH_CACHE) >= _DH_CACHE_MAX:
+                _DH_CACHE.clear()  # hard bound; entries are round-scoped
+            _DH_CACHE[key] = v
     return v
 
 
@@ -145,9 +155,10 @@ def purge_dh_secrets(*sks: int) -> None:
     Call when a round's secure state is discarded — after this, only a
     party still holding the ephemeral sk itself can rederive the pairwise
     seeds (the forward-secrecy contract of per-round keypairs)."""
-    dead = [k for k in _DH_CACHE if k[0] in sks]
-    for k in dead:
-        del _DH_CACHE[k]
+    with _DH_CACHE_LOCK:
+        dead = [k for k in _DH_CACHE if k[0] in sks]
+        for k in dead:
+            del _DH_CACHE[k]
 
 
 def dh_shared_seed(sk: int, pk_other: int, context: str) -> bytes:

@@ -643,3 +643,100 @@ def test_secure_round_64_cohort_scaling():
             await r.cleanup()
 
     run(main())
+
+
+def test_stale_secure_finalization_never_touches_replacement_round():
+    """A finalization can lose its round while blocked in the
+    reconstruction worker thread (realistic path: mass cull -> abort ->
+    fresh start, the starvation scenario the thread offload exists
+    for). Aborted rounds REUSE their round name (reference naming
+    parity, rounds.py::abort_round), so the stale finalizer must detect
+    the replacement by secure-state IDENTITY — a name check cannot —
+    and leave the new round completely untouched."""
+    import threading
+
+    async def main():
+        exp, workers, runners, mport = await _secure_federation(
+            3, silent_last=True
+        )
+
+        entered = threading.Event()
+        release = threading.Event()
+        orig_reconstruct = secure.shamir_reconstruct
+
+        def blocking_reconstruct(shares):
+            entered.set()
+            assert release.wait(timeout=30.0), "test never released thread"
+            return orig_reconstruct(shares)
+
+        secure.shamir_reconstruct = blocking_reconstruct
+
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    f"http://127.0.0.1:{mport}/securetest/start_round"
+                    "?n_epoch=1"
+                ) as resp:
+                    assert resp.status == 200
+                # with a silent member the round never auto-ends: wait
+                # for both reporters, then trigger finalization — it
+                # enters the blocked reconstruction thread (the silent
+                # member is the dropped one whose key gets rebuilt)
+                for _ in range(600):
+                    if len(exp.rounds.client_responses) == 2:
+                        break
+                    await asyncio.sleep(0.05)
+                assert len(exp.rounds.client_responses) == 2
+                exp.end_round()
+                for _ in range(600):
+                    if entered.is_set():
+                        break
+                    await asyncio.sleep(0.05)
+                assert entered.is_set(), "finalization never reconstructed"
+                stale_task = exp._secure_task
+
+                # the interleaving under test: the round is aborted and
+                # a NEW round starts while the thread still runs. Mute
+                # every worker first so round 2 cannot complete and the
+                # assertable end state is unambiguous.
+                async def _mute(round_name, n_samples, loss_history):
+                    return None
+
+                for w in workers:
+                    w.report_update = _mute
+                old_name = exp.rounds.round_name
+                exp.rounds.abort_round()
+                exp._secure_round = None
+                async with session.get(
+                    f"http://127.0.0.1:{mport}/securetest/start_round"
+                    "?n_epoch=1"
+                ) as resp:
+                    assert resp.status == 200
+                # the premise that makes a name-based guard insufficient
+                assert exp.rounds.round_name == old_name
+                new_sr = exp._secure_round
+                assert new_sr is not None
+
+                release.set()
+                await stale_task
+
+                # the stale finalizer owned nothing anymore: the
+                # replacement round must still be running, with its own
+                # secure state, and no false failure recorded
+                snap = exp.metrics.snapshot()
+                assert exp.rounds.in_progress
+                assert exp._secure_round is new_sr
+                assert snap["counters"].get(
+                    "secure_rounds_unrecoverable", 0.0) == 0.0
+                assert snap["counters"].get("rounds_finished", 0.0) == 0.0
+        finally:
+            release.set()
+            secure.shamir_reconstruct = orig_reconstruct
+            exp.rounds.abort_round()
+            exp._secure_round = None
+            for r in runners:
+                await r.cleanup()
+
+    run(main())
