@@ -719,13 +719,21 @@ class Experiment:
             return client_id, None
 
     async def _request_unmask(
-        self, client_id: str, round_name: str, survivors, dropped
+        self, client_id: str, round_name: str, survivors, dropped,
+        c_pk: int,
     ):
-        """Unmasking with one reporter → its share bundle or None."""
+        """Unmasking with one reporter → its share bundle or None.
+
+        ``c_pk`` (the reporter's own advertised mask public key) binds
+        the request to ONE key-generation instance: aborted rounds
+        reuse their name, so a stale finalizer's delayed unmask could
+        otherwise pin its partition onto a same-name replacement
+        round's state — the worker refuses on key mismatch."""
         return await self._secure_post(
             client_id,
             "secure_unmask",
-            {"round": round_name, "survivors": survivors, "dropped": dropped},
+            {"round": round_name, "survivors": survivors,
+             "dropped": dropped, "c_pk": f"{c_pk:x}"},
         )
 
     async def _notify_client(
@@ -936,7 +944,8 @@ class Experiment:
             bundles = await asyncio.gather(
                 *[
                     self._request_unmask(
-                        rid, sr["round_name"], survivors, dropped
+                        rid, sr["round_name"], survivors, dropped,
+                        sr["c_pks"][rid],
                     )
                     for rid in survivors
                 ]

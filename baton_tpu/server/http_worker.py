@@ -254,6 +254,14 @@ class ExperimentWorker:
         # detectable by state identity — exactly the manager-side
         # finalization rule — or it would overwrite a replacement
         # round's keys and desynchronize the whole cohort's masks
+        replaced = self._secure.get(round_name)
+        if replaced is not None:
+            # re-keying a live name discards the old state in place —
+            # the eviction loop below won't see it, so its cached DH
+            # powers must be dropped here (forward-secrecy contract)
+            secure.purge_dh_secrets(
+                *[k for k in (replaced.get("c_sk"), replaced.get("s_sk"))
+                  if k is not None])
         st = {"pending": True, "peer_shares": {}, "partition": None}
         self._secure[round_name] = st
         while len(self._secure) > 2:  # keep current + previous round
@@ -377,6 +385,16 @@ class ExperimentWorker:
         round_name = str(data.get("round", ""))
         st = self._secure_state(round_name)
         if st is None or "cohort" not in st:
+            return web.json_response({"err": "Unknown Round"}, status=410)
+        try:
+            req_c_pk = int(str(data.get("c_pk", "")), 16)
+        except ValueError:
+            req_c_pk = None
+        if req_c_pk != st["c_pk"]:
+            # the request is bound to a different key-generation
+            # instance of this round NAME (aborted rounds reuse names):
+            # a stale finalizer must not pin its partition onto the
+            # replacement round's state
             return web.json_response({"err": "Unknown Round"}, status=410)
         survivors = sorted(map(str, data.get("survivors", [])))
         dropped = sorted(map(str, data.get("dropped", [])))

@@ -135,6 +135,14 @@ import threading as _threading
 _DH_CACHE: Dict[Tuple[int, int], bytes] = {}
 _DH_CACHE_MAX = 16384
 _DH_CACHE_LOCK = _threading.Lock()
+# Tombstones for purged secret keys: a ~7 ms modexp in flight on a pool
+# thread when its sk is purged would otherwise re-insert the dead
+# round's shared secret AFTER the purge, silently undoing it. sks are
+# per-round ephemerals and never legitimately reused after purge, so
+# refusing future cache inserts for them costs nothing. Insertion-
+# ordered with a hard cap — oldest tombstones fall off.
+_DH_PURGED: Dict[int, None] = {}
+_DH_PURGED_MAX = 4096
 
 
 def _dh_raw(sk: int, pk_other: int) -> bytes:
@@ -144,9 +152,10 @@ def _dh_raw(sk: int, pk_other: int) -> bytes:
     if v is None:
         v = pow(pk_other, sk, MODP_P).to_bytes(256, "big")
         with _DH_CACHE_LOCK:
-            if len(_DH_CACHE) >= _DH_CACHE_MAX:
-                _DH_CACHE.clear()  # hard bound; entries are round-scoped
-            _DH_CACHE[key] = v
+            if sk not in _DH_PURGED:
+                if len(_DH_CACHE) >= _DH_CACHE_MAX:
+                    _DH_CACHE.clear()  # hard bound; entries are round-scoped
+                _DH_CACHE[key] = v
     return v
 
 
@@ -154,8 +163,14 @@ def purge_dh_secrets(*sks: int) -> None:
     """Drop every cached DH power derived from the given secret keys.
     Call when a round's secure state is discarded — after this, only a
     party still holding the ephemeral sk itself can rederive the pairwise
-    seeds (the forward-secrecy contract of per-round keypairs)."""
+    seeds (the forward-secrecy contract of per-round keypairs). Purged
+    keys are tombstoned so a concurrent in-flight derivation cannot
+    re-insert them."""
     with _DH_CACHE_LOCK:
+        for sk in sks:
+            _DH_PURGED[sk] = None
+        while len(_DH_PURGED) > _DH_PURGED_MAX:
+            _DH_PURGED.pop(next(iter(_DH_PURGED)))
         dead = [k for k in _DH_CACHE if k[0] in sks]
         for k in dead:
             del _DH_CACHE[k]

@@ -302,6 +302,11 @@ def _recorded_conv_winner(path=None):
             continue
         best = None
         for tag, r in fm.items():
+            if "@" in tag:
+                # "@w16" waved-fallback measurements are diagnostic
+                # datapoints for plan-skipped configs, not adoptable
+                # headline configs (the headline runs full-wave)
+                continue
             if (isinstance(r, dict)
                     and isinstance(r.get("rounds_per_sec"), (int, float))):
                 if best is None or r["rounds_per_sec"] > best[1]:

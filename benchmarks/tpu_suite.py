@@ -292,11 +292,26 @@ def child_conv() -> dict:
 
             plan_gb = fedsim_wave_plan_gb(sim, params, data, n_samples, key)
             kclass = conv_kernel_class(impl, bs)
+            wave_kw = {}
             if plan_gb is not None and plan_gb > hbm_budget_gb(dev, kclass):
                 out["full_model"][tag] = {
                     "batch_size": bs, **_plan_skip_fields(plan_gb),
                 }
-                continue
+                # fallback: a half-cohort wave still yields a real
+                # throughput datapoint for the lowering comparison
+                # instead of a bare skip (the r4 failure mode for
+                # im2col). Diagnostic only — the "@w16" key is ignored
+                # by the winner selection, which adopts full-wave
+                # configs exclusively.
+                half_plan = fedsim_wave_plan_gb(sim, params, data,
+                                                n_samples, key,
+                                                wave_size=16)
+                if (half_plan is None
+                        or half_plan > hbm_budget_gb(dev, kclass)):
+                    continue
+                tag = f"{tag}@w16"
+                plan_gb = half_plan
+                wave_kw = {"wave_size": 16}
             # fault isolation: a transport flake on one config must not
             # take out the remaining configs — this child crashed
             # wholesale on exactly that during round 4's first live
@@ -306,7 +321,8 @@ def child_conv() -> dict:
             # timeout — abort and return the partial record instead.
             try:
                 _, dt, compile_s = _timed_rounds(
-                    sim, params, data, n_samples, key, 2 if SMOKE else 12)
+                    sim, params, data, n_samples, key, 2 if SMOKE else 12,
+                    **wave_kw)
             except Exception as e:
                 out["full_model"][tag] = {
                     "batch_size": bs,
@@ -321,6 +337,7 @@ def child_conv() -> dict:
             sps = C * spc / dt
             out["full_model"][tag] = {
                 "batch_size": bs,
+                **({"wave_size": wave_kw["wave_size"]} if wave_kw else {}),
                 "rounds_per_sec": round(1 / dt, 3),
                 "samples_per_sec_per_chip": round(sps, 1),
                 "mfu_analytic": round(

@@ -146,10 +146,15 @@ def test_recorded_conv_winner_trusts_only_tpu_records(bench, tmp_path,
                         "broken": {"error": "X"},
                         "skipped": {"skipped": "plan", "plan_gb": None}}},
         # a later TPU record with a malformed batch_size must not crash
-        # the bench, and falls back to batch 32
+        # the bench, and falls back to batch 32; the "@w16"
+        # waved-fallback diagnostic must never be adopted even when it
+        # posts the best rounds/s (it is not a full-wave config)
         {"stage": "conv", "platform": "tpu",
          "full_model": {"im2col": {"rounds_per_sec": 9.9,
-                                   "batch_size": None}}},
+                                   "batch_size": None},
+                        "shift@w16": {"rounds_per_sec": 99.0,
+                                      "batch_size": 32,
+                                      "wave_size": 16}}},
     ]
     jl.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
     # scope the redirect to the module under test (patching the shared

@@ -415,9 +415,13 @@ def test_fabricated_dropout_claim_is_refused():
         helper = workers[1]
         round_name = workers[1].last_update
         cohort = sorted(w.client_id for w in workers)
-        honest = {"round": round_name,
+        # the attacker is the honest-but-curious SERVER, which knows
+        # every advertised pk — binding requests to c_pk (stale-round
+        # detection) is no obstacle to it
+        c_pk = f"{helper._secure[round_name]['c_pk']:x}"
+        honest = {"round": round_name, "c_pk": c_pk,
                   "survivors": cohort, "dropped": []}
-        lying = {"round": round_name,
+        lying = {"round": round_name, "c_pk": c_pk,
                  "survivors": sorted(set(cohort) - {victim}),
                  "dropped": [victim]}
         url = (
@@ -427,6 +431,13 @@ def test_fabricated_dropout_claim_is_refused():
         import aiohttp
 
         async with aiohttp.ClientSession() as session:
+            # a request bound to a DIFFERENT key-generation instance of
+            # this round name (stale finalizer after abort + same-name
+            # restart) is refused before it can touch the partition
+            async with session.post(
+                url, json=dict(honest, c_pk="deadbeef")
+            ) as resp:
+                assert resp.status == 410
             # the honest partition was already pinned by the real
             # finalization — the lying one must be refused outright
             async with session.post(url, json=lying) as resp:
@@ -469,6 +480,7 @@ def test_unmask_rejects_sub_threshold_survivor_sets():
         # t = 3//2+1 = 2; claiming only the helper survived (1 < t)
         greedy = {
             "round": round_name,
+            "c_pk": f"{helper._secure[round_name]['c_pk']:x}",
             "survivors": [helper.client_id],
             "dropped": sorted(set(cohort) - {helper.client_id}),
         }
