@@ -85,6 +85,34 @@ def configure_jax_for_bench() -> None:
             pass  # a malformed artifact must never kill a bench run
 
 
+def resolve_artifact_path(out_path: str, run_has_tpu_success: bool,
+                          prior_has_tpu_success) -> str:
+    """Shared artifact-clobber policy for the hardware sweeps
+    (wave_sweep.py, attention_sweep.py): never overwrite a recorded
+    artifact holding TPU measurements with a run that produced none —
+    a tunnel outage timing out every cell, or a CPU smoke run with
+    plausible-looking numbers (both observed, r4). The lesser run is
+    still evidence: it goes to a ``*_failed`` sibling instead.
+
+    ``prior_has_tpu_success`` is a callable applied to the parsed prior
+    JSON (artifact shapes differ per sweep); unreadable/foreign priors
+    are treated as clobber-safe."""
+    import json as _json
+
+    if run_has_tpu_success:
+        return out_path
+    try:
+        with open(out_path) as f:
+            prior = _json.load(f)
+        keep = bool(prior_has_tpu_success(prior))
+    except (OSError, ValueError, TypeError, AttributeError, KeyError):
+        return out_path
+    if not keep:
+        return out_path
+    base, ext = os.path.splitext(out_path)
+    return f"{base}_failed{ext or '.json'}"
+
+
 def is_oom_error(e: Exception) -> bool:
     """True when an exception is XLA saying the program cannot fit in
     device memory. On real TPU backends an over-HBM program fails at

@@ -23,18 +23,45 @@ import json
 import os
 import time
 
+import sys
+
 import jax
 
-# the JAX_PLATFORMS env var does not reliably override this container's
-# axon plugin (a cpu-intended run can hang dialing a dark tunnel at
-# first backend touch); only jax.config pins deterministically
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-import jax.numpy as jnp
+from baton_tpu.utils.profiling import (  # noqa: E402
+    configure_jax_for_bench,
+    resolve_artifact_path,
+)
+
+# pins an explicit JAX_PLATFORMS=cpu request through jax.config (the env
+# var alone does not reliably override this container's axon plugin) and
+# enables the persistent compilation cache — this sweep compiles dozens
+# of kernel variants, so a retried run skips straight to timing
+configure_jax_for_bench()
+
+import jax.numpy as jnp  # noqa: E402
 
 from baton_tpu.models.transformer import dot_product_attention
 from baton_tpu.ops.flash_attention import flash_attention
+
+
+def _has_tpu_timing(payload) -> bool:
+    """True when the artifact carries at least one real TPU timing —
+    the 'success' predicate for the shared clobber guard."""
+    if payload.get("platform") != "tpu":
+        return False
+    for r in payload.get("results", ()):
+        if isinstance(r.get("dense_ms"), (int, float)):
+            return True
+        if isinstance(r.get("jax_pallas_ms"), (int, float)):
+            return True
+        if any(isinstance(v, (int, float))
+               for v in (r.get("flash") or {}).values()):
+            return True
+    return False
 
 
 def timeit(fn, L, b=4, h=8, d=64, iters=10):
@@ -75,9 +102,18 @@ def main():
     for L in (int(x) for x in args.lens.split(",")):
         rec = {"L": L, "flash": {}}
         if L <= args.dense_max:
-            d = timeit(dot_product_attention, L)
-            rec["dense_ms"] = round(d, 2)
-            print(f"L={L} dense fwd+bwd {d:.2f} ms")
+            # per-cell fault isolation: one transient tunnel flake (the
+            # r4 window lost whole stages to exactly that) must not
+            # discard the cells already measured or still measurable
+            try:
+                d = timeit(dot_product_attention, L)
+            except Exception as e:
+                rec["dense_error"] = f"{type(e).__name__}: {e}"[:200]
+                d = None
+                print(f"L={L} dense FAILED: {e}")
+            else:
+                rec["dense_ms"] = round(d, 2)
+                print(f"L={L} dense fwd+bwd {d:.2f} ms")
         else:
             d = None
             print(f"L={L} dense skipped (scores tensor would OOM; "
@@ -106,27 +142,39 @@ def main():
             bq, bk = (int(x) for x in spec.split("x"))
             if bq > L or bk > L:
                 continue
-            f = timeit(
-                lambda q, k, v, **kw: flash_attention(
-                    q, k, v, block_q=bq, block_k=bk, **kw
-                ),
-                L,
-            )
+            try:
+                f = timeit(
+                    lambda q, k, v, **kw: flash_attention(
+                        q, k, v, block_q=bq, block_k=bk, **kw
+                    ),
+                    L,
+                )
+            except Exception as e:
+                rec.setdefault("flash_errors", {})[spec] = (
+                    f"{type(e).__name__}: {e}"[:200])
+                print(f"  flash bq={bq} bk={bk} FAILED: {e}")
+                continue
             rec["flash"][spec] = round(f, 2)
             ratio = f" ({d / f:.2f}x)" if d else ""
             print(f"  flash bq={bq} bk={bk}: {f:.2f} ms{ratio}")
         results.append(rec)
         # write after every length: a mid-sweep tunnel death keeps the
-        # lengths already measured
-        with open(args.out, "w") as f:
-            json.dump({
-                "platform": dev.platform,
-                "device_kind": getattr(dev, "device_kind", dev.platform),
-                "shape": {"batch": 4, "heads": 8, "head_dim": 64,
-                          "dtype": "bfloat16", "causal": True,
-                          "measure": "fwd+bwd(q,k,v), mean of 10"},
-                "results": results,
-            }, f, indent=2)
+        # lengths already measured. Clobber-guarded per write (shared
+        # policy, profiling.resolve_artifact_path): an all-failure TPU
+        # run or a CPU smoke run is diverted to *_failed instead of
+        # overwriting recorded hardware timings.
+        payload = {
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "shape": {"batch": 4, "heads": 8, "head_dim": 64,
+                      "dtype": "bfloat16", "causal": True,
+                      "measure": "fwd+bwd(q,k,v), mean of 10"},
+            "results": results,
+        }
+        dest = resolve_artifact_path(
+            args.out, _has_tpu_timing(payload), _has_tpu_timing)
+        with open(dest, "w") as f:
+            json.dump(payload, f, indent=2)
 
 
 if __name__ == "__main__":

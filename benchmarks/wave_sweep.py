@@ -27,6 +27,12 @@ import subprocess
 import sys
 import time
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    # invoked as `python benchmarks/wave_sweep.py`: sys.path[0] is
+    # benchmarks/, so the baton_tpu package needs the repo root added
+    sys.path.insert(0, _REPO)
+
 N_CLIENTS = 128
 SAMPLES_PER_CLIENT = 48
 BATCH_SIZE = 32
@@ -129,24 +135,16 @@ def _has_tpu_success(results) -> bool:
 
 
 def resolve_out_path(out_path: str, results: list) -> str:
-    """Never clobber a recorded artifact holding TPU measurements with a
-    run that produced none (observed r4: a tunnel outage timed out all
-    three waves and the all-failure run overwrote the r3 hardware
-    numbers; a CPU smoke run would do the same with plausible-looking
-    numbers). The lesser run is still evidence — it goes to a
-    ``*_failed.json`` sibling instead."""
-    if _has_tpu_success(results):
-        return out_path
-    try:
-        with open(out_path) as f:
-            prior = json.load(f)
-        prior_tpu = _has_tpu_success(prior.get("results", ()))
-    except (OSError, ValueError, TypeError, AttributeError):
-        return out_path
-    if not prior_tpu:
-        return out_path
-    base, ext = os.path.splitext(out_path)
-    return f"{base}_failed{ext or '.json'}"
+    """Artifact-clobber guard — the shared policy lives in
+    profiling.resolve_artifact_path; this wrapper supplies the
+    wave-sweep artifact shape."""
+    from baton_tpu.utils.profiling import resolve_artifact_path
+
+    return resolve_artifact_path(
+        out_path,
+        _has_tpu_success(results),
+        lambda prior: _has_tpu_success(prior.get("results", ())),
+    )
 
 
 def main() -> None:
