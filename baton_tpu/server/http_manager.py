@@ -46,6 +46,7 @@ broadcast anchor.
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Any, Dict, Optional
 
 import aiohttp
@@ -64,6 +65,8 @@ from baton_tpu.server.utils import PeriodicTask, json_clean
 from baton_tpu.utils.metrics import Metrics
 
 DEFAULT_N_EPOCH = 32  # reference manager.py:52-55
+
+_log = logging.getLogger(__name__)
 
 
 class Manager:
@@ -106,6 +109,7 @@ class Experiment:
         metrics: Optional[Metrics] = None,
         secure_agg: bool = False,
         secure_scale_bits: int = 16,
+        secure_phase_timeout: Optional[float] = None,
         aggregator: str = "mean",
         cohort_fraction: float = 1.0,
         min_cohort: int = 1,
@@ -186,6 +190,8 @@ class Experiment:
         self.allow_pickle = allow_pickle
         self.secure_agg = secure_agg
         self.secure_scale_bits = secure_scale_bits
+        self.secure_phase_timeout = secure_phase_timeout
+        self._rejection_logged_round: Optional[tuple] = None
         # live secure round: {"round_name", "cohort": [ids], "pks": {id: int}}
         self._secure_round: Optional[dict] = None
         self._secure_outboxes: Optional[dict] = None
@@ -234,6 +240,14 @@ class Experiment:
         self._maybe_finish()
 
     async def _watchdog_tick(self) -> None:
+        if self._broadcasting:
+            # round setup (secure phases + broadcast) is still running:
+            # ending the round now would strand the in-flight broadcast
+            # on a dead round_name — the same knife-edge class as the
+            # cull-tick abort, one tick over. The straggler timeout is
+            # for clients that fail to REPORT, and nobody has even been
+            # notified yet.
+            return
         if self.rounds.is_expired:
             self.end_round()  # partial aggregation of whoever reported
 
@@ -478,6 +492,27 @@ class Experiment:
     async def start_round(self, n_epoch: int) -> Dict[str, bool]:
         round_name = self.rounds.start_round(n_epoch=n_epoch)
         self._secure_round = None  # invalidate any stale secure state
+        # _broadcasting must cover the WHOLE round setup — the secure
+        # key/share phases included, not just the notify fan-out:
+        # participants are only recorded at broadcast time, so a cull
+        # tick firing during a long pre-broadcast phase sees
+        # len(rounds)==0 and aborts a healthy round. Observed: EVERY
+        # C=256 secure round died exactly this way (the O(C^2) share
+        # phase outlasts the ttl/2=150 s cull period; C=128's ~135 s
+        # phase just squeaked under — another knife edge).
+        self._broadcasting = True
+        try:
+            result = await self._start_round_phases(round_name, n_epoch)
+        finally:
+            self._broadcasting = False
+        # every participant may have reported during the (deferred)
+        # broadcast window — settle the round now that the guard is down
+        self._maybe_finish()
+        return result
+
+    async def _start_round_phases(
+        self, round_name: str, n_epoch: int
+    ) -> Dict[str, bool]:
         for cid in self.registry.cull():
             self.rounds.drop_client(cid)
         if not len(self.registry) and self.simulator is None:
@@ -530,6 +565,13 @@ class Experiment:
             )
             pks = {cid: p for cid, p in pk_results if p is not None}
             if not pks:
+                # observable abort: a silent {} return made a whole
+                # cohort's failure look like "workers never responded"
+                # (C=256 postmortem, CHANGES_r5.md)
+                self.metrics.inc("secure_rounds_aborted_keys")
+                _log.warning(
+                    "%s: secure round aborted — no member advertised "
+                    "keys (cohort %d)", self.name, len(cohort_ids))
                 self.rounds.abort_round()
                 return {}
             cohort_a = sorted(pks)
@@ -551,6 +593,12 @@ class Experiment:
             if len(cohort) < t:
                 # fewer sharers than the reconstruction threshold: the
                 # round could never be unmasked — abort before training
+                self.metrics.inc("secure_rounds_aborted_shares")
+                _log.warning(
+                    "%s: secure round aborted — %d/%d members completed "
+                    "ShareKeys, below threshold t=%d (phase budget %.0fs)",
+                    self.name, len(cohort), len(cohort_a), t,
+                    self._secure_phase_budget_s())
                 self.rounds.abort_round()
                 return {}
             self._secure_round = {
@@ -609,16 +657,12 @@ class Experiment:
         else:
             recipients = cohort_ids
             bodies = {cid: body for cid in recipients}
-        self._broadcasting = True
-        try:
-            results = await asyncio.gather(
-                *[
-                    self._notify_client(cid, bodies[cid], ctype)
-                    for cid in recipients
-                ]
-            )
-        finally:
-            self._broadcasting = False
+        results = await asyncio.gather(
+            *[
+                self._notify_client(cid, bodies[cid], ctype)
+                for cid in recipients
+            ]
+        )
 
         if self.simulator is not None:
             self.rounds.client_start("__simulated__")
@@ -633,10 +677,6 @@ class Experiment:
         if self.rounds.in_progress and not len(self.rounds):
             self.rounds.abort_round()
             self._secure_round = None
-            return dict(results)
-        # every participant may have reported during the (deferred)
-        # broadcast window — settle the round now
-        self._maybe_finish()
         return dict(results)
 
     def _sample_cohort(self) -> list:
@@ -649,6 +689,18 @@ class Experiment:
         k = min(len(ids), max(self.min_cohort,
                               int(round(self.cohort_fraction * len(ids)))))
         return sorted(self._cohort_rng.sample(ids, k))
+
+    def _secure_phase_budget_s(self) -> float:
+        """Per-request timeout for the secure-protocol phases. The
+        ShareKeys phase is O(C) 2048-bit modexps PER MEMBER (O(C^2)
+        total) — a fixed budget that is generous at 64 members starves
+        the whole cohort at 256 (observed: aiohttp's default 300 s
+        total timeout vs ~540 s of aggregate box building in the
+        one-process benchmark topology), so the default scales with
+        registry size. ``secure_phase_timeout`` overrides."""
+        if self.secure_phase_timeout is not None:
+            return self.secure_phase_timeout
+        return max(300.0, 3.0 * max(1, len(self.registry)))
 
     async def _secure_post(self, client_id: str, endpoint: str, payload: dict):
         """POST a secure-protocol message to one worker; None on any
@@ -663,12 +715,22 @@ class Experiment:
             f"?client_id={client_id}&key={client.key}"
         )
         try:
-            async with self._session.post(url, json=payload) as resp:
+            async with self._session.post(
+                url, json=payload,
+                timeout=aiohttp.ClientTimeout(
+                    total=self._secure_phase_budget_s()),
+            ) as resp:
                 if resp.status == 200:
                     return await resp.json()
                 if resp.status == 404:
                     self.registry.drop(client_id)
                 # 409/410 etc.: alive but unavailable for this round
+        except asyncio.TimeoutError:
+            # alive but too slow for this phase: cohort exclusion, NOT
+            # eviction — and never let the bare TimeoutError (which is
+            # not an aiohttp.ClientError) escape into the phase gather,
+            # where it would 500 the whole start_round
+            return None
         except (aiohttp.ClientError, ValueError, KeyError):
             self.registry.drop(client_id)
         return None
@@ -739,11 +801,24 @@ class Experiment:
     async def _notify_client(
         self, client_id: str, body: bytes, content_type: str = wire.CONTENT_TYPE
     ):
-        client = self.registry[client_id]
+        try:
+            client = self.registry[client_id]
+        except UnknownClient:
+            # culled during the (possibly minutes-long) secure phases
+            # between cohort sampling and this notify — skip, don't let
+            # the exception escape the broadcast gather and 500 the
+            # whole cohort's start_round
+            return client_id, False
         url = f"{client.url.rstrip('/')}/round_start?client_id={client_id}&key={client.key}"
+        # secure broadcasts scale like the share phases (each recipient
+        # decrypts O(C) relayed boxes before acking): give them the same
+        # cohort-scaled budget instead of aiohttp's default 300 s
+        post_kw = ({"timeout": aiohttp.ClientTimeout(
+            total=self._secure_phase_budget_s())} if self.secure_agg else {})
         try:
             async with self._session.post(
-                url, data=body, headers={"Content-Type": content_type}
+                url, data=body, headers={"Content-Type": content_type},
+                **post_kw,
             ) as resp:
                 if resp.status == 200:
                     # record participation NOW, before yielding back to
@@ -753,10 +828,39 @@ class Experiment:
                         self.rounds.client_start(client_id)
                         return client_id, True
                     return client_id, False
+                # observable rejection: a cohort-wide refusal (e.g. every
+                # worker 400ing the broadcast) must be distinguishable
+                # from "workers never answered" — the C=256 postmortem
+                # burned an hour on exactly that ambiguity
+                self.metrics.inc(f"broadcast_rejected_{resp.status}")
+                # dedup key includes started_at: aborted rounds REUSE
+                # their name, so a name-only key would suppress the
+                # retry round's first rejection — the exact diagnostic
+                # this log exists to surface
+                round_key = (self.rounds.round_name, self.rounds.started_at)
+                if self._rejection_logged_round != round_key:
+                    self._rejection_logged_round = round_key
+                    try:
+                        body_txt = (await resp.text())[:200]
+                    except Exception:
+                        body_txt = "<unreadable>"
+                    _log.warning(
+                        "%s: broadcast rejected by %s: HTTP %d %s "
+                        "(first rejection this round; counters track "
+                        "the rest)", self.name, client_id, resp.status,
+                        body_txt)
                 if resp.status == 404:
                     self.registry.drop(client_id)
                     self.rounds.drop_client(client_id)
                 return client_id, False
+        except asyncio.TimeoutError:
+            # alive but slow (e.g. still decrypting its share inbox):
+            # skip it this round WITHOUT eviction, and count it — a
+            # cohort-wide broadcast timeout must be visible (the C=256
+            # silent-abort postmortem)
+            self.metrics.inc("broadcast_timeout")
+            self.rounds.drop_client(client_id)
+            return client_id, False
         except aiohttp.ClientError:
             self.registry.drop(client_id)
             self.rounds.drop_client(client_id)
@@ -802,9 +906,7 @@ class Experiment:
         try:
             result = await asyncio.to_thread(run)
         except Exception as exc:  # XLA/shape/OOM errors must not hang the round
-            import logging
-
-            logging.getLogger(__name__).exception(
+            _log.exception(
                 "simulated cohort failed in %s: %s", round_name, exc
             )
             if self.rounds.in_progress and self.rounds.round_name == round_name:

@@ -133,7 +133,14 @@ def dh_keypair() -> Tuple[int, int]:
 import threading as _threading
 
 _DH_CACHE: Dict[Tuple[int, int], bytes] = {}
-_DH_CACHE_MAX = 16384
+# Sized for a 256-member cohort's full pair matrix (256·255 = 65,280
+# entries, ~26 MB of 2048-bit powers). The old 16384 cap sat on a knife
+# edge: C=128 (16,256 pairs) just fit, while C=256 wholesale-clear()ed
+# the cache mid-protocol — every worker's inbox decryption then
+# recomputed 255 modexps, the broadcast phase ballooned ~540 s past the
+# manager's HTTP timeout, and the whole cohort silently failed to ack.
+# Eviction is oldest-first (insertion order), never a wholesale clear.
+_DH_CACHE_MAX = 65536
 _DH_CACHE_LOCK = _threading.Lock()
 # Tombstones for purged secret keys: a ~7 ms modexp in flight on a pool
 # thread when its sk is purged would otherwise re-insert the dead
@@ -153,8 +160,8 @@ def _dh_raw(sk: int, pk_other: int) -> bytes:
         v = pow(pk_other, sk, MODP_P).to_bytes(256, "big")
         with _DH_CACHE_LOCK:
             if sk not in _DH_PURGED:
-                if len(_DH_CACHE) >= _DH_CACHE_MAX:
-                    _DH_CACHE.clear()  # hard bound; entries are round-scoped
+                while len(_DH_CACHE) >= _DH_CACHE_MAX:
+                    _DH_CACHE.pop(next(iter(_DH_CACHE)))
                 _DH_CACHE[key] = v
     return v
 

@@ -75,6 +75,28 @@ async def _one_cohort(n: int, n_silent: int) -> dict:
     exp = manager.register_experiment(
         model, name="securebench", round_timeout=900.0, secure_agg=True
     )
+    if os.environ.get("BATON_DEBUG_STACKS"):
+        # whoever kills the round, say so with a stack: the C=256
+        # silent-abort hunt burned multiple runs on "who called this"
+        import traceback
+
+        _orig_abort = exp.rounds.abort_round
+        _orig_end = exp.rounds.end_round
+
+        def _abort_dbg():
+            print("[dbg] abort_round:", file=sys.stderr, flush=True)
+            traceback.print_stack(file=sys.stderr)
+            return _orig_abort()
+
+        def _end_dbg():
+            print("[dbg] end_round (state machine):", file=sys.stderr,
+                  flush=True)
+            traceback.print_stack(file=sys.stderr)
+            return _orig_end()
+
+        exp.rounds.abort_round = _abort_dbg
+        exp.rounds.end_round = _end_dbg
+
     mrunner = web.AppRunner(mapp)
     await mrunner.setup()
     await web.TCPSite(mrunner, "127.0.0.1", mport).start()
@@ -90,9 +112,14 @@ async def _one_cohort(n: int, n_silent: int) -> dict:
         wport = _free_port()
         cls = _SilentWorker if i >= n - n_silent else ExperimentWorker
         wapp = web.Application()
+        # heartbeat at the reference default (60 s, worker.py:14), not
+        # an aggressive 5 s: C co-located workers share ONE loop with
+        # the GIL-bound crypto pool, and 256 workers × 5 s = 51 HTTP
+        # round-trips/s through a GIL-starved loop drowned the upload
+        # dispatches entirely (zero responses at C=256)
         worker = cls(
             wapp, model, f"127.0.0.1:{mport}", name="securebench",
-            port=wport, heartbeat_time=5.0, trainer=shared,
+            port=wport, heartbeat_time=60.0, trainer=shared,
             get_data=lambda d=data: (d, d["x"].shape[0]),
         )
         wrunner = web.AppRunner(wapp)
@@ -112,11 +139,20 @@ async def _one_cohort(n: int, n_silent: int) -> dict:
     n_report = n - n_silent
     shamir_t = n // 2 + 1
     t0 = time.perf_counter()
-    async with aiohttp.ClientSession() as session:
+    # start_round answers only after the full AdvertiseKeys+ShareKeys
+    # fan-out (O(C^2) sealed boxes, serialized in this one process) —
+    # at C=256 that alone exceeds aiohttp's default 300 s total timeout
+    timeout = aiohttp.ClientTimeout(total=3600.0)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
         async with session.get(
             f"http://127.0.0.1:{mport}/securebench/start_round?n_epoch=1"
         ) as resp:
             assert resp.status == 200
+            acks = await resp.json()
+            print(f"[{n}] start_round acks: {len(acks)} total, "
+                  f"{sum(bool(v) for v in acks.values())} true; "
+                  f"in_progress={exp.rounds.in_progress}",
+                  file=sys.stderr, flush=True)
         # Wait for all reporters OR a plateau: with C workers sharing
         # ONE process/event loop, the largest cohorts starve some honest
         # workers (observed: 24/128 never upload — their heartbeats and
@@ -125,11 +161,22 @@ async def _one_cohort(n: int, n_silent: int) -> dict:
         # once responses plateau above the Shamir threshold we end the
         # round and let seed-reveal recovery absorb the stragglers.
         last_n, last_t = -1, time.perf_counter()
+        last_status = time.perf_counter()
         ended_via, plateau_wait_s = "all_reported", 0.0
         while True:
             got = len(exp.rounds.client_responses)
             if got == n_report:
                 break
+            if time.perf_counter() - last_status > 60.0:
+                # a silent round is undiagnosable from outside this
+                # process: say WHERE the cohort is stuck
+                last_status = time.perf_counter()
+                snap = exp.metrics.snapshot()
+                print(f"[{n}] status in_progress={exp.rounds.in_progress} "
+                      f"round_clients={len(exp.rounds.clients)} "
+                      f"responses={got} registry={len(exp.registry)} "
+                      f"counters={snap['counters']}",
+                      file=sys.stderr, flush=True)
             if got != last_n:
                 last_n, last_t = got, time.perf_counter()
                 print(f"[{n}] responses {got}/{n_report} "
@@ -147,7 +194,11 @@ async def _one_cohort(n: int, n_silent: int) -> dict:
                       f"stragglers become Shamir-recovered dropouts",
                       file=sys.stderr, flush=True)
                 break
-            if time.perf_counter() - last_t > 600.0:
+            # stall guard scales with C: before the FIRST response can
+            # land, every member must finish the serialized O(C) mask
+            # derivation (~2 s each at C=256 on one core) — a flat 600 s
+            # declared a healthy 256-member round dead
+            if time.perf_counter() - last_t > max(600.0, 5.0 * n):
                 raise RuntimeError(
                     f"stalled at {got}/{n_report} below the Shamir "
                     f"threshold {shamir_t}")
@@ -211,6 +262,14 @@ async def _one_cohort(n: int, n_silent: int) -> dict:
 
 
 def main() -> None:
+    if os.environ.get("BATON_DEBUG_STACKS"):
+        # kill -USR1 <pid> dumps every thread's stack to stderr —
+        # the one-process C-client topology makes "slow grind" vs
+        # "deadlock" undiagnosable from the outside otherwise
+        import faulthandler
+        import signal
+
+        faulthandler.register(signal.SIGUSR1)
     ap = argparse.ArgumentParser()
     ap.add_argument("--cohorts", default="16,64,128")
     args = ap.parse_args()
