@@ -1,0 +1,48 @@
+"""JAX version compatibility shims for the parallel layer.
+
+``jax.shard_map`` became a top-level API (with the ``check_vma``
+keyword) only in recent JAX; on 0.4.x the same transform lives at
+``jax.experimental.shard_map.shard_map`` and spells the varying-
+manifest check ``check_rep``. Every shard_map call site in this
+package goes through :func:`shard_map` so the repo imports and runs on
+both spellings — a bare ``from jax import shard_map`` breaks module
+import (and with it test collection) on 0.4.37.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+_NATIVE = hasattr(jax, "shard_map")
+
+if not _NATIVE:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+    """``jax.shard_map`` when available, else the experimental one with
+    ``check_vma`` translated to its old name ``check_rep``. Usable both
+    directly and via ``functools.partial`` as a decorator."""
+    if _NATIVE:
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kwargs,
+        )
+    # check_rep is always disabled on the fallback path: the legacy
+    # replication checker predates lax.pcast, so code annotated for the
+    # varying-manifest world (ring_attention's per-step lax.cond) trips
+    # it with false "mismatched replication types" errors.
+    return _experimental_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False, **kwargs,
+    )
+
+
+def pcast_varying(x, axis_name: str):
+    """``lax.pcast(x, axis, to="varying")`` on JAX versions with the
+    varying-manifest API; identity on 0.4.x, whose replication checker
+    has no per-value manifest to adjust."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return x

@@ -43,6 +43,7 @@ from baton_tpu.core.partition import PathPredicate, make_partition
 from baton_tpu.core.training import LocalTrainer, make_local_trainer, make_evaluator
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.ops.padding import round_up
+from baton_tpu.parallel.compat import shard_map
 from baton_tpu.parallel.mesh import CLIENT_AXIS, client_sharding
 from baton_tpu.parallel.tensor_parallel import MODEL_AXIS, shard_params_tp
 
@@ -276,7 +277,7 @@ class FedSim:
                     params, frozen, data, n_samples, rngs, n_epochs
                 )
 
-            cache[n_epochs] = jax.jit(jax.shard_map(
+            cache[n_epochs] = jax.jit(shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=(P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
@@ -308,7 +309,7 @@ class FedSim:
                 wtot = jax.lax.psum(local_w, CLIENT_AXIS)
                 return psum, lsum, wtot, client_losses
 
-            sharded = jax.shard_map(
+            sharded = shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=(P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),

@@ -47,6 +47,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from baton_tpu.ops import aggregation as agg
+from baton_tpu.parallel.compat import shard_map
 from baton_tpu.parallel.engine import FedSim
 from baton_tpu.parallel.mesh import (
     CLIENT_AXIS,
@@ -172,7 +173,7 @@ class FedBuff:
                     anchors, data, n_samples, rngs, n_epochs, frozen
                 )
 
-            cache[n_epochs] = jax.jit(jax.shard_map(
+            cache[n_epochs] = jax.jit(shard_map(
                 kernel,
                 mesh=mesh,
                 in_specs=(P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS),

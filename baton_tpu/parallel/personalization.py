@@ -35,6 +35,7 @@ import numpy as np
 
 from baton_tpu.core.partition import PathPredicate, make_partition
 from baton_tpu.ops import aggregation as agg
+from baton_tpu.parallel.compat import shard_map
 from baton_tpu.parallel.engine import FedSim, client_eval_sums
 
 Params = Any
@@ -179,7 +180,7 @@ class FedPer:
                                                           CLIENT_AXIS)
                 return new_pers, shared_agg, pers_mean, loss_hist, closs
 
-            self._jit_cache[key] = jax.jit(jax.shard_map(
+            self._jit_cache[key] = jax.jit(shard_map(
                 kernel,
                 mesh=self.sim.mesh,
                 in_specs=(P(CLIENT_AXIS), P(), P(CLIENT_AXIS),

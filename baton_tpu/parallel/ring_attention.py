@@ -45,8 +45,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from baton_tpu.parallel.compat import pcast_varying, shard_map
 
 SEQ_AXIS = "seq"
 
@@ -115,7 +116,7 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = False,
     # loop (ppermute outputs are varying); mark them varying up front so
     # the fori_loop carry types are stable
     def varying(x):
-        return lax.pcast(x, (axis_name,), to="varying")
+        return pcast_varying(x, axis_name)
 
     if bias is None:
         # locally-created zeros are invariant; the real bias arrives as a
@@ -225,7 +226,7 @@ def _flash_ring_fwd(q, k, v, bias2d, axis_name, causal, block_q, block_k,
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def varying(x):
-        return lax.pcast(x, (axis_name,), to="varying")
+        return pcast_varying(x, axis_name)
 
     if bias2d is None:
         bias2d = varying(jnp.zeros((q.shape[0], k.shape[2]), jnp.float32))
@@ -278,7 +279,7 @@ def _flash_ring_bwd(axis_name, causal, block_q, block_k, interpret,
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def varying(x):
-        return lax.pcast(x, (axis_name,), to="varying")
+        return pcast_varying(x, axis_name)
 
     had_bias = bias2d is not None
     if bias2d is None:

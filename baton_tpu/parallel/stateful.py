@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from baton_tpu.ops import aggregation as agg
+from baton_tpu.parallel.compat import shard_map
 from baton_tpu.parallel.engine import FedSim, _server_update
 
 Params = Any
@@ -127,7 +128,7 @@ class StatefulClients:
                                                           CLIENT_AXIS)
                 return aggregate, new_os, loss_hist, closs
 
-            self._jit_cache[key] = jax.jit(jax.shard_map(
+            self._jit_cache[key] = jax.jit(shard_map(
                 kernel,
                 mesh=self.sim.mesh,
                 in_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS),

@@ -114,6 +114,9 @@ class Experiment:
         cohort_fraction: float = 1.0,
         min_cohort: int = 1,
         broadcast_quantize_bits: Optional[int] = None,
+        journal_path: Optional[str] = None,
+        journal_fsync: Any = "always",
+        recovery_policy: str = "resume",
     ):
         """``aggregator``: ``"mean"`` (sample-weighted FedAvg, reference
         manager.py:119-126), or Byzantine-robust ``"trimmed:<ratio>"`` /
@@ -133,7 +136,19 @@ class Experiment:
         (ops/compression.py::quantize_state_dict), 4x/2x smaller on the
         wire. All cohort members dequantize the SAME tensors, so every
         client still starts from identical params, and sparse uplink
-        deltas are reconstructed against the dequantized anchor."""
+        deltas are reconstructed against the dequantized anchor.
+
+        ``journal_path``: enable the control-plane write-ahead journal
+        (server/journal.py) at this path. On construction the journal is
+        replayed: the client registry (ids, auth keys, callback URLs)
+        and round counter come back, and an in-flight round is handled
+        per ``recovery_policy`` — ``"resume"`` re-announces the round to
+        its surviving participants under its original name so their
+        trained updates still land; ``"abort"`` discards it cleanly.
+        Secure-aggregation rounds always abort on recovery: the mask/
+        share state lived only in the dead process. ``journal_fsync``
+        is the :class:`~baton_tpu.server.journal.Journal` policy
+        (``"always"`` | ``"never"`` | seconds between fsyncs)."""
         if secure_agg and allow_pickle:
             raise ValueError(
                 "secure_agg is incompatible with allow_pickle: reference-"
@@ -164,12 +179,27 @@ class Experiment:
                 "server only ever sees the cohort SUM, never per-client "
                 "updates to trim or take medians over"
             )
+        if recovery_policy not in ("resume", "abort"):
+            raise ValueError(
+                f"recovery_policy must be 'resume' or 'abort', "
+                f"got {recovery_policy!r}"
+            )
+        self.recovery_policy = recovery_policy
         self.name = name
         self.app = app
         self.model = model
         self.params = params if params is not None else model.init(jax.random.key(rng_seed))
-        self.registry = ClientRegistry(name, client_ttl=client_ttl)
-        self.rounds = RoundManager(name, round_timeout=round_timeout)
+        self.journal = None
+        if journal_path is not None:
+            from baton_tpu.server.journal import Journal
+
+            self.journal = Journal(journal_path, fsync=journal_fsync)
+        self.registry = ClientRegistry(
+            name, client_ttl=client_ttl, journal=self.journal
+        )
+        self.rounds = RoundManager(
+            name, round_timeout=round_timeout, journal=self.journal
+        )
         self.metrics = metrics or Metrics()
         self.checkpointer = None
         if checkpoint_dir is not None:
@@ -187,6 +217,12 @@ class Experiment:
                     restored.meta.get("n_rounds", restored.step),
                     restored.meta.get("loss_history", []),
                 )
+        # the round in flight at crash time, recovered from the journal
+        # and awaiting re-announce once the event loop is up
+        self._recovered_round: Optional[dict] = None
+        self._recovery_task = None
+        if self.journal is not None:
+            self._recover_from_journal(secure_agg)
         self.allow_pickle = allow_pickle
         self.secure_agg = secure_agg
         self.secure_scale_bits = secure_scale_bits
@@ -209,6 +245,116 @@ class Experiment:
             app.on_startup.append(self._start_background)
             app.on_cleanup.append(self._stop_background)
 
+    # -- crash recovery ------------------------------------------------
+    def _recover_from_journal(self, secure_agg: bool) -> None:
+        """Replay snapshot+journal: rebuild membership (ids, keys,
+        callback URLs) and the round counter, and stage any in-flight
+        round for :meth:`_resume_round` once the event loop is up."""
+        rec = self.journal.recover()
+        if rec.empty:
+            return
+        for cid, c in rec.clients.items():
+            self.registry.restore_client(
+                cid,
+                key=c.get("key"),
+                remote=c.get("remote"),
+                port=c.get("port"),
+                url=c.get("url"),
+                registered_at=c.get("registered_at"),
+                num_updates=c.get("num_updates", 0),
+                last_update=c.get("last_update"),
+            )
+        # the journal records every completed round (including the ones
+        # the checkpoint's async save may not have landed before the
+        # crash), so it is at least as new as the checkpoint — unless
+        # journaling was enabled later, in which case keep the
+        # checkpoint's counter/history
+        if rec.n_rounds >= self.rounds.n_rounds:
+            self.rounds.restore(rec.n_rounds, rec.loss_history)
+        _log.info(
+            "%s: recovered %d clients, %d completed rounds from journal",
+            self.name, len(rec.clients), self.rounds.n_rounds,
+        )
+        if rec.open_round is None:
+            return
+        if self.recovery_policy == "abort" or secure_agg:
+            # secure rounds can never resume: the mask/share directory
+            # (self._secure_round) died with the process, so surviving
+            # masked uploads could not be unmasked anyway
+            reason = "secure_agg" if secure_agg else "recovery_policy"
+            self.rounds._journal(
+                "round_aborted",
+                round_name=rec.open_round["round_name"], reason=reason,
+            )
+            self.metrics.inc("recovery_rounds_aborted")
+            _log.warning(
+                "%s: in-flight round %s aborted on recovery (%s)",
+                self.name, rec.open_round["round_name"], reason,
+            )
+            return
+        self._recovered_round = rec.open_round
+
+    async def _resume_round(self) -> None:
+        """Re-announce the journal-recovered in-flight round to its
+        surviving participants under its ORIGINAL name, so updates they
+        trained before the crash (still parked in their outboxes,
+        http_worker.py) land in the resumed round."""
+        info = self._recovered_round
+        self._recovered_round = None
+        if info is None or self.rounds.in_progress:
+            return
+        round_name = info["round_name"]
+        meta = dict(info.get("meta") or {})
+        n_epoch = int(meta.get("n_epoch", DEFAULT_N_EPOCH))
+        cohort = [
+            cid for cid in sorted(info.get("participants") or [])
+            if cid in self.registry
+        ]
+        if not cohort:
+            self.rounds._journal(
+                "round_aborted", round_name=round_name,
+                reason="no surviving participants",
+            )
+            self.metrics.inc("recovery_rounds_aborted")
+            _log.warning(
+                "%s: round %s had no surviving participants; aborted",
+                self.name, round_name,
+            )
+            return
+        self.rounds.resume_round(round_name, **meta)
+        self.metrics.inc("recovery_rounds_resumed")
+        _log.info(
+            "%s: resuming round %s with %d participants",
+            self.name, round_name, len(cohort),
+        )
+        # resumed broadcasts are always dense: the quantization seed and
+        # anchor of the original broadcast died with the old process, and
+        # a different anchor would corrupt sparse-delta reconstruction
+        state_dict = {
+            k: np.asarray(v)
+            for k, v in params_to_state_dict(self.params).items()
+        }
+        self._broadcast_anchor_sd = state_dict
+        meta_out = {"update_name": round_name, "n_epoch": n_epoch}
+        if self.allow_pickle:
+            body = wire.encode_pickle(state_dict, meta_out)
+            ctype = wire.PICKLE_CONTENT_TYPE
+        else:
+            body = wire.encode(state_dict, meta_out)
+            ctype = wire.CONTENT_TYPE
+        self._broadcasting = True
+        try:
+            await asyncio.gather(
+                *[self._notify_client(cid, body, ctype) for cid in cohort]
+            )
+        finally:
+            self._broadcasting = False
+        if self.rounds.in_progress and not len(self.rounds):
+            self.rounds.abort_round("resume broadcast unacknowledged")
+            self.metrics.inc("recovery_rounds_aborted")
+            return
+        self._maybe_finish()
+
     # ------------------------------------------------------------------
     async def _start_background(self, app=None) -> None:
         cull = PeriodicTask(self._cull_tick, max(self.registry.client_ttl / 2, 1))
@@ -218,10 +364,17 @@ class Experiment:
                 self._watchdog_tick, max(self.rounds.round_timeout / 4, 0.25)
             )
             self._background.append(watchdog.start())
+        if self._recovered_round is not None:
+            self._recovery_task = asyncio.get_running_loop().create_task(
+                self._resume_round()
+            )
 
     async def _stop_background(self, app=None) -> None:
         for task in self._background:
             await task.stop()
+        if self._recovery_task is not None:
+            await self._recovery_task
+            self._recovery_task = None
         if self._secure_task is not None:
             await self._secure_task
             self._secure_task = None
@@ -232,6 +385,8 @@ class Experiment:
             self._checkpoint_task = None
         if self.checkpointer is not None:
             self.checkpointer.close()
+        if self.journal is not None:
+            self.journal.close()
 
     async def _cull_tick(self) -> None:
         for cid in self.registry.cull():
@@ -342,6 +497,7 @@ class Experiment:
             # loss_history 400s at the door instead of 500ing later
             meta_n_samples = float(meta.get("n_samples", 0))
             meta_losses = [float(x) for x in meta.get("loss_history", [])]
+            update_id = str(meta["update_id"]) if meta.get("update_id") else None
             compressed_anchor = None
             if meta.get("compressed"):
                 if self.secure_agg:
@@ -403,6 +559,16 @@ class Experiment:
             return web.json_response(
                 {"error": "Not A Participant"}, status=410
             )
+        if (
+            update_id is not None
+            and self.rounds.update_ids.get(client_id) == update_id
+        ):
+            # the worker's at-least-once outbox retried an upload whose
+            # first delivery DID land (e.g. the 200 was lost in transit).
+            # Ack idempotently without re-counting: folding it in twice
+            # would double this client's sample weight in the aggregate.
+            self.metrics.inc("duplicate_updates_deduped")
+            return web.json_response("OK")
         if compressed_anchor is not None:
             # reconstruct AFTER the round checks: the anchor (this
             # round's broadcast == self.params, unchanged until
@@ -417,6 +583,7 @@ class Experiment:
                 "masked": bool(meta.get("secure", False)),
                 "n_samples": meta_n_samples,
                 "loss_history": meta_losses,
+                "update_id": update_id,
             },
         )
         self.registry.record_update(client_id, round_name)
@@ -1182,6 +1349,7 @@ class Experiment:
 
     def _record_history_and_checkpoint(self, reports, n_epoch) -> None:
         # loss history: sample-weighted per-epoch mean (manager.py:127-130)
+        appended = []
         for epoch in range(n_epoch):
             num = sum(
                 r["loss_history"][epoch] * r["n_samples"]
@@ -1193,6 +1361,11 @@ class Experiment:
             )
             if den:
                 self.rounds.loss_history.append(num / den)
+                appended.append(float(num / den))
+        if self.journal is not None:
+            if appended:
+                self.journal.append("losses_appended", values=appended)
+            self._compact_journal()
         if self.checkpointer is not None:
             # Even with wait=False, orbax's save() blocks synchronously on
             # any still-in-flight previous async save — under slow storage
@@ -1226,6 +1399,24 @@ class Experiment:
                     self.checkpointer.save(
                         step, self.params, meta=meta, wait=False
                     )
+
+    def _compact_journal(self) -> None:
+        """Snapshot the full control-plane state and truncate the journal.
+        Runs at round end (a quiescent point — the snapshot schema has no
+        open round) so the journal only ever holds one round's events."""
+        if self.rounds.in_progress:
+            return
+        from baton_tpu.server.journal import registry_snapshot
+
+        self.journal.compact(
+            {
+                "clients": registry_snapshot(self.registry),
+                "n_rounds": self.rounds.n_rounds,
+                "loss_history": [
+                    float(x) for x in self.rounds.loss_history
+                ],
+            }
+        )
 
     def round_state(self) -> dict:
         return {

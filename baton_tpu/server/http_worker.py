@@ -12,6 +12,14 @@ train locally, POST the result to ``update`` — with the recorded fixes
   reference blocked its event loop for the whole local run.
 * Heartbeat backoff is capped exponential (reference doubled unboundedly,
   worker.py:78 ``# TODO: better backoff``).
+* At-least-once uploads: a trained update is parked in a one-slot
+  outbox and retried with capped exponential backoff + jitter until the
+  manager answers 200 (delivered) or 410 (round dead — abandoned), a
+  401 triggering re-registration in between. The reference — and the
+  seed before this — dropped the whole round's training on the first
+  failed POST. Every upload carries a fresh ``update_id`` so the
+  manager dedupes redelivery (a 200 lost in transit must not
+  double-count the client's samples in the aggregate).
 * Weights travel as BTW1 tensors, not pickles (pickle decode opt-in).
 * Mid-training visibility (reference utils.py:70-91 streams tqdm batch
   progress + a running loss): the jitted multi-epoch run reports each
@@ -29,6 +37,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import random
 import secrets
 import weakref
 from typing import Callable, Optional, Tuple
@@ -43,11 +52,26 @@ from baton_tpu.core.training import LocalTrainer, make_local_trainer
 from baton_tpu.ops.padding import pad_dataset, round_up
 from baton_tpu.server import wire
 from baton_tpu.server.state import params_to_state_dict, state_dict_to_params
-from baton_tpu.server.utils import PeriodicTask
+from baton_tpu.server.utils import PeriodicTask, random_key
 from baton_tpu.utils.metrics import Metrics
 
 GetData = Callable[[], Tuple[dict, int]]
 MAX_BACKOFF = 60.0
+
+
+@dataclasses.dataclass
+class _PendingUpdate:
+    """One-slot durable outbox entry: the encoded upload for the round
+    in flight, kept until the manager acks (200) or declares the round
+    dead (410). ``compressed_template`` is the pre-compression delta —
+    needed to fold the kept mass back into the error-feedback residual
+    if the update is abandoned rather than delivered."""
+
+    round_name: str
+    update_id: str
+    body: bytes
+    compressed_template: Optional[dict] = None
+    attempts: int = 0
 
 
 def _parse_compress(spec: Optional[str], seed: int = 0):
@@ -94,12 +118,16 @@ class ExperimentWorker:
         rng_seed: int = 0,
         auto_register: bool = True,
         compress: Optional[str] = None,
+        outbox_backoff: Tuple[float, float] = (0.25, 10.0),
     ):
         """``compress`` turns on sparse round-delta uploads
         (ops/compression.py): ``"topk:0.05"`` keeps the top 5% of delta
         coordinates per tensor with error feedback across rounds;
         ``"topk:0.05:q8"`` additionally quantizes kept values to int8.
-        Ignored for secure rounds (masking needs dense ring elements)."""
+        Ignored for secure rounds (masking needs dense ring elements).
+
+        ``outbox_backoff``: ``(base, cap)`` seconds for the upload retry
+        schedule — capped exponential with jitter."""
         self.name = name or getattr(model, "name", "fedmodel")
         self.model = model
         self.metrics = Metrics()
@@ -131,6 +159,9 @@ class ExperimentWorker:
         self.key: Optional[str] = None
         self.n_updates = 0
         self.round_in_progress = False
+        self.outbox_backoff = outbox_backoff
+        self._pending: Optional[_PendingUpdate] = None
+        self._outbox_task: Optional[asyncio.Task] = None
         # guards the broadcast handler's await windows (body read, boxed-
         # share decryption in a worker thread): a duplicate round_start
         # arriving mid-handler must 409 exactly like one arriving
@@ -162,6 +193,12 @@ class ExperimentWorker:
     async def _on_cleanup(self, app=None) -> None:
         if self._heartbeat_task is not None:
             await self._heartbeat_task.stop()
+        if self._outbox_task is not None and not self._outbox_task.done():
+            self._outbox_task.cancel()
+            try:
+                await self._outbox_task
+            except asyncio.CancelledError:
+                pass
         if self.__session is not None:
             await self.__session.close()
 
@@ -530,6 +567,13 @@ class ExperimentWorker:
                 k: np.asarray(v, np.float32)
                 for k, v in params_to_state_dict(new_params).items()
             }
+        if self._pending is not None:
+            # an accepted broadcast supersedes any undelivered previous
+            # update — including a manager-resumed round re-announcing
+            # the SAME name: we retrain from the fresh broadcast, and
+            # letting the stale body race the new one could count this
+            # worker twice in the resumed round
+            self._cancel_pending("superseded")
         self.last_update = round_name
         self.round_in_progress = True
         asyncio.ensure_future(self._run_round(round_name, n_epoch))
@@ -600,14 +644,16 @@ class ExperimentWorker:
     async def report_update(
         self, round_name: str, n_samples: int, loss_history
     ) -> None:
-        url = (
-            self.manager_url
-            + f"update?client_id={self.client_id}&key={self.key}"
-        )
+        """Encode the trained update and park it in the outbox; actual
+        delivery (with retries) happens in :meth:`_drain_outbox`. Returns
+        as soon as the slot is filled, so the caller's round bookkeeping
+        never waits on the network."""
+        update_id = random_key(16)
         meta = {
             "update_name": round_name,
             "n_samples": int(n_samples),
             "loss_history": [float(x) for x in loss_history],
+            "update_id": update_id,
         }
         st = self._secure.get(round_name)
         compressed_payload = None  # set only on the compressed branch
@@ -672,24 +718,88 @@ class ExperimentWorker:
             )
         else:
             body = wire.encode(params_to_state_dict(self.params), meta)
-        delivered = False
-        try:
-            async with self._session.post(
-                url, data=body, headers={"Content-Type": wire.CONTENT_TYPE}
-            ) as resp:
-                if resp.status == 200:
-                    self.n_updates += 1
-                    delivered = True
-                elif resp.status == 401:
-                    await self.register_with_manager()
-                # 410: reported a stale round; nothing to do (parity with
-                # reference worker.py:123-124)
-        except aiohttp.ClientError:
-            pass  # manager down; heartbeat loop will re-establish contact
-        if compressed_payload is not None and not delivered:
+        self._enqueue_update(
+            _PendingUpdate(
+                round_name=round_name,
+                update_id=update_id,
+                body=body,
+                compressed_template=(
+                    compressed_template
+                    if compressed_payload is not None
+                    else None
+                ),
+            )
+        )
+
+    # -- at-least-once outbox ------------------------------------------
+    def _enqueue_update(self, pending: _PendingUpdate) -> None:
+        # one slot: a newer round's update supersedes anything still
+        # undelivered (the manager 410s stale rounds anyway)
+        if self._pending is not None:
+            self._cancel_pending("superseded")
+        self._pending = pending
+        self.metrics.set_gauge("outbox_pending", 1)
+        if self._outbox_task is None or self._outbox_task.done():
+            self._outbox_task = asyncio.ensure_future(self._drain_outbox())
+
+    def _cancel_pending(self, reason: str) -> None:
+        p, self._pending = self._pending, None
+        self.metrics.set_gauge("outbox_pending", 0)
+        if p is not None and p.compressed_template is not None:
             # the kept mass never reached the manager: fold it back into
             # the error-feedback residual or it is lost for good
-            self.compressor.restore(compressed_template)
+            self.compressor.restore(p.compressed_template)
+        if p is not None:
+            self.metrics.inc(f"updates_abandoned_{reason}")
+
+    async def _drain_outbox(self) -> None:
+        """Retry the parked upload until the manager answers 200
+        (delivered) or 410 (round dead): capped exponential backoff with
+        jitter, re-registering on 401 so the retry after a manager
+        restart carries fresh credentials."""
+        base, cap = self.outbox_backoff
+        while (p := self._pending) is not None:
+            status = await self._post_update(p)
+            if self._pending is not p:
+                continue  # superseded while the POST was in flight
+            if status == 200:
+                self._pending = None
+                self.metrics.set_gauge("outbox_pending", 0)
+                self.n_updates += 1
+                self.metrics.inc("updates_delivered")
+                continue
+            if status == 410:
+                # the round is gone (aborted, force-ended, or we were
+                # dropped from it): this update can never land
+                self._cancel_pending("round_gone")
+                continue
+            # undeliverable right now (connection refused, 5xx, 401):
+            # keep the slot and back off
+            p.attempts += 1
+            self.metrics.inc("update_retries")
+            if status == 401:
+                # manager restarted without its registry: rejoin, then
+                # retry the SAME update under the new credentials
+                await self.register_with_manager()
+            delay = min(base * (2 ** (p.attempts - 1)), cap)
+            await asyncio.sleep(delay * (0.5 + random.random() / 2))
+
+    async def _post_update(self, p: _PendingUpdate) -> Optional[int]:
+        """One delivery attempt; the HTTP status or None on transport
+        failure. The URL is rebuilt per attempt: credentials may have
+        rotated via a 401 → re-register cycle between attempts."""
+        url = (
+            self.manager_url
+            + f"update?client_id={self.client_id}&key={self.key}"
+        )
+        try:
+            async with self._session.post(
+                url, data=p.body,
+                headers={"Content-Type": wire.CONTENT_TYPE},
+            ) as resp:
+                return resp.status
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            return None  # manager down; the backoff loop keeps trying
 
     # ------------------------------------------------------------------
     def get_data(self) -> Tuple[dict, int]:

@@ -1,0 +1,266 @@
+"""Write-ahead journal for the manager control plane.
+
+The paper's failure model (heartbeat/TTL culling, 401 re-registration)
+assumes the *manager* never dies: the client registry — ids, auth keys,
+callback URLs — and the running round's state live only in process
+memory, so a coordinator crash forgets every credential it ever issued
+and silently discards the in-flight round's training. Production FL
+coordinators journal exactly this state (Bonawitz et al., *Towards
+Federated Learning at Scale*, §4: the "master" persists its state so a
+restart is a pause, not an amnesia event).
+
+This module is the durability layer: an append-only JSONL journal of
+control-plane *events*, replayed on boot to rebuild the registry and
+round state. Model params are NOT journaled — they ride the existing
+orbax :class:`baton_tpu.utils.checkpoint.Checkpointer`; the journal
+covers the cheap-but-critical metadata the checkpoint does not.
+
+Design points:
+
+* **Event vocabulary** (one JSON object per line, ``{"event": ...}``):
+  ``client_registered`` / ``client_dropped`` for membership,
+  ``round_started`` / ``round_client_joined`` / ``round_client_dropped``
+  / ``update_accepted`` / ``round_ended`` / ``round_aborted`` /
+  ``losses_appended`` for rounds. ``update_accepted`` carries the
+  upload's dedup key (``update_id``) — the at-least-once worker outbox
+  (http_worker.py) may deliver the same update many times, and the
+  buffered-aggregation weighting (FedBuff, Nguyen et al.) is only
+  correct if each update is folded in exactly once.
+* **fsync policy**: ``"always"`` (default — fsync every append; an
+  acknowledged state transition survives power loss), ``"never"``
+  (flush to the OS only), or a float (minimum seconds between fsyncs —
+  bounded-loss batching for hot registries).
+* **Compaction**: :meth:`Journal.compact` writes a snapshot of the full
+  control-plane state atomically (temp file + rename, same discipline
+  as orbax) and truncates the journal. The manager piggybacks this on
+  its per-round checkpoint, so the journal only ever holds events since
+  the last completed round.
+* **Torn writes**: a crash mid-append leaves a partial final line;
+  :meth:`Journal.load` skips undecodable lines (warning, not error), so
+  recovery always sees the longest valid prefix.
+
+Auth keys are journaled in the clear by necessity — they are what make
+"workers keep their credentials across a manager restart" possible.
+Treat the journal file like the TLS private key: same filesystem
+permissions, same operator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+_log = logging.getLogger(__name__)
+
+SNAPSHOT_SUFFIX = ".snapshot"
+
+
+class Journal:
+    """Append-only JSONL event log with snapshot+truncate compaction."""
+
+    def __init__(self, path: str, fsync: Any = "always"):
+        if fsync not in ("always", "never") and not isinstance(
+            fsync, (int, float)
+        ):
+            raise ValueError(
+                f"fsync must be 'always', 'never' or seconds, got {fsync!r}"
+            )
+        self.path = os.path.abspath(path)
+        self.snapshot_path = self.path + SNAPSHOT_SUFFIX
+        self.fsync = fsync
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._last_fsync = 0.0
+        self.appends = 0
+
+    # ------------------------------------------------------------------
+    def append(self, event: str, **fields: Any) -> None:
+        """Durably record one control-plane event."""
+        rec = {"event": event, **fields}
+        self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._fh.flush()
+        self._maybe_fsync()
+        self.appends += 1
+
+    def _maybe_fsync(self) -> None:
+        if self.fsync == "never":
+            return
+        if self.fsync == "always":
+            os.fsync(self._fh.fileno())
+            return
+        now = time.monotonic()
+        if now - self._last_fsync >= float(self.fsync):
+            os.fsync(self._fh.fileno())
+            self._last_fsync = now
+
+    # ------------------------------------------------------------------
+    def load(self) -> Tuple[Optional[dict], List[dict]]:
+        """(snapshot | None, events) currently on disk — the recovery
+        input. Undecodable journal lines (torn final write) are skipped
+        with a warning so the longest valid prefix always replays."""
+        snapshot = None
+        if os.path.exists(self.snapshot_path):
+            try:
+                with open(self.snapshot_path, "r", encoding="utf-8") as fh:
+                    snapshot = json.load(fh)
+            except (OSError, json.JSONDecodeError) as exc:
+                # a half-written snapshot cannot happen (atomic rename),
+                # so this is real corruption — recover from events alone
+                _log.warning("journal snapshot unreadable (%s); ignoring", exc)
+        events: List[dict] = []
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        _log.warning(
+                            "journal %s line %d undecodable (torn write?); "
+                            "skipped", self.path, lineno)
+                        continue
+                    if isinstance(rec, dict) and "event" in rec:
+                        events.append(rec)
+        except OSError:
+            pass
+        return snapshot, events
+
+    def recover(self) -> "RecoveredState":
+        snapshot, events = self.load()
+        return replay(snapshot, events)
+
+    # ------------------------------------------------------------------
+    def compact(self, snapshot: dict) -> None:
+        """Write ``snapshot`` atomically, then truncate the journal.
+
+        Call only at a quiescent point (no round in flight): the
+        snapshot schema carries membership and history, not an open
+        round, so compacting mid-round would forget it."""
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(snapshot, fh, separators=(",", ":"))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.snapshot_path)
+        # events up to here are superseded by the snapshot: truncate
+        self._fh.close()
+        self._fh = open(self.path, "w", encoding="utf-8")
+        self._fh.flush()
+        if self.fsync != "never":
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self._fh.flush()
+            if self.fsync != "never":
+                os.fsync(self._fh.fileno())
+            self._fh.close()
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class RecoveredState:
+    """Control-plane state rebuilt from snapshot + journal replay."""
+
+    clients: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    n_rounds: int = 0
+    loss_history: List[float] = dataclasses.field(default_factory=list)
+    #: the in-flight round at crash time, or None:
+    #: {round_name, meta, participants: [ids], accepted: {cid: update_id}}
+    open_round: Optional[dict] = None
+    #: True when neither snapshot nor events existed — a fresh journal
+    #: must not override e.g. a checkpoint-restored round counter.
+    empty: bool = True
+
+
+def replay(
+    snapshot: Optional[dict], events: Iterable[dict]
+) -> RecoveredState:
+    """Fold snapshot + events into the state the manager died with.
+
+    Replay is pure and total: unknown event types are ignored (forward
+    compatibility), events referencing unknown clients/rounds are
+    no-ops, so any valid journal prefix produces a usable state."""
+    st = RecoveredState()
+    if snapshot:
+        st.empty = False
+        st.clients = {
+            str(cid): dict(c) for cid, c in (snapshot.get("clients") or {}).items()
+        }
+        st.n_rounds = int(snapshot.get("n_rounds", 0))
+        st.loss_history = [float(x) for x in snapshot.get("loss_history", [])]
+    for ev in events:
+        st.empty = False
+        kind = ev.get("event")
+        cid = ev.get("client_id")
+        if kind == "client_registered":
+            st.clients[cid] = {
+                k: ev.get(k)
+                for k in ("key", "remote", "port", "url", "registered_at")
+            }
+            st.clients[cid].setdefault("num_updates", 0)
+        elif kind == "client_dropped":
+            st.clients.pop(cid, None)
+            if st.open_round is not None:
+                st.open_round["participants"].discard(cid)
+                st.open_round["accepted"].pop(cid, None)
+        elif kind == "round_started":
+            st.open_round = {
+                "round_name": ev.get("round_name"),
+                "meta": ev.get("meta") or {},
+                "participants": set(),
+                "accepted": {},
+            }
+        elif kind == "round_client_joined":
+            if st.open_round is not None:
+                st.open_round["participants"].add(cid)
+        elif kind == "round_client_dropped":
+            if st.open_round is not None:
+                st.open_round["participants"].discard(cid)
+                st.open_round["accepted"].pop(cid, None)
+        elif kind == "update_accepted":
+            if st.open_round is not None:
+                st.open_round["accepted"][cid] = ev.get("update_id")
+            c = st.clients.get(cid)
+            if c is not None:
+                c["num_updates"] = int(c.get("num_updates") or 0) + 1
+                c["last_update"] = ev.get("round_name")
+        elif kind == "round_ended":
+            st.n_rounds = int(ev.get("n_rounds", st.n_rounds + 1))
+            st.open_round = None
+        elif kind == "round_aborted":
+            st.open_round = None
+        elif kind == "losses_appended":
+            st.loss_history.extend(float(x) for x in ev.get("values", []))
+    return st
+
+
+def registry_snapshot(registry) -> Dict[str, dict]:
+    """The per-client snapshot schema (mirrors ``client_registered``
+    event fields) from a live :class:`ClientRegistry`."""
+    return {
+        cid: {
+            "key": c.key,
+            "remote": c.remote,
+            "port": c.port,
+            "url": c.url,
+            "registered_at": c.registered_at,
+            "num_updates": c.num_updates,
+            "last_update": c.last_update,
+        }
+        for cid, c in registry.clients.items()
+    }

@@ -55,11 +55,17 @@ class ClientRegistry:
         name: str,
         client_ttl: float = 300.0,
         clock: Callable[[], float] = time.time,
+        journal=None,
     ):
         self.name = name
         self.client_ttl = client_ttl
         self._clock = clock
+        self.journal = journal
         self.clients: Dict[str, Client] = {}
+
+    def _journal(self, event: str, **fields) -> None:
+        if self.journal is not None:
+            self.journal.append(event, **fields)
 
     def __len__(self) -> int:
         return len(self.clients)
@@ -94,6 +100,43 @@ class ClientRegistry:
             last_heartbeat=now,
             registered_at=now,
         )
+        # journal before exposing the credential: a crash after the
+        # worker learns its key must still find the key on replay
+        self._journal(
+            "client_registered",
+            client_id=client_id, key=key, remote=remote, port=port,
+            url=url, registered_at=now,
+        )
+        self.clients[client_id] = client
+        return client
+
+    def restore_client(
+        self,
+        client_id: str,
+        key: str,
+        remote: Optional[str] = None,
+        port: Optional[int] = None,
+        url: Optional[str] = None,
+        registered_at: Optional[float] = None,
+        num_updates: int = 0,
+        last_update: Optional[str] = None,
+    ) -> Client:
+        """Re-admit a journal-recovered client with its original id and
+        auth key. Not journaled (the journal already knows it); the
+        heartbeat clock restarts now so recovery downtime doesn't count
+        against the TTL."""
+        now = self._clock()
+        client = Client(
+            client_id=client_id,
+            key=key,
+            remote=remote,
+            port=port,
+            url=url,
+            last_heartbeat=now,
+            registered_at=registered_at if registered_at is not None else now,
+            last_update=last_update,
+            num_updates=int(num_updates or 0),
+        )
         self.clients[client_id] = client
         return client
 
@@ -111,6 +154,10 @@ class ClientRegistry:
         return client_id
 
     def drop(self, client_id: str) -> None:
+        if client_id in self.clients:
+            self._journal(
+                "client_dropped", client_id=client_id, reason="dropped"
+            )
         self.clients.pop(client_id, None)
 
     def cull(self) -> List[str]:
@@ -123,6 +170,7 @@ class ClientRegistry:
             if (now - c.last_heartbeat) > self.client_ttl
         ]
         for cid in stale:
+            self._journal("client_dropped", client_id=cid, reason="culled")
             del self.clients[cid]
         return stale
 

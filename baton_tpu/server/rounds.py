@@ -17,6 +17,14 @@ Deliberate fixes over the reference (SURVEY §2.9, keep/fix record):
 * Exception hierarchy KEPT: RoundError/RoundInProgress/RoundNotInProgress
   mirror UpdateException/UpdateInProgress/UpdateNotInProgress
   (update_manager.py:5-14).
+
+Durability: when constructed with a ``journal``
+(:class:`baton_tpu.server.journal.Journal`), every state transition is
+appended to it *before* the in-memory mutation, so a crash at any point
+leaves the journal a superset of memory and replay cannot lose an
+acknowledged transition. ``client_end`` journals only the response's
+scalar envelope (n_samples, update_id) — never the tensors, which are
+the checkpoint's job.
 """
 
 from __future__ import annotations
@@ -45,10 +53,12 @@ class RoundManager:
         name: Optional[str] = None,
         round_timeout: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
+        journal=None,
     ):
         self.name = name or random_key(6)
         self.round_timeout = round_timeout
         self._clock = clock
+        self.journal = journal
         self.loss_history: list = []
         self.n_rounds = 0
         self._in_progress = False
@@ -58,8 +68,13 @@ class RoundManager:
         self.round_name = f"update_{self.name}_{self.n_rounds:05d}"
         self.clients: Set[str] = set()
         self.client_responses: Dict[str, Any] = {}
+        self.update_ids: Dict[str, str] = {}
         self.round_meta: Optional[dict] = None
         self.started_at: Optional[float] = None
+
+    def _journal(self, event: str, **fields: Any) -> None:
+        if self.journal is not None:
+            self.journal.append(event, **fields)
 
     # ------------------------------------------------------------------
     @property
@@ -92,6 +107,28 @@ class RoundManager:
         if self._in_progress:
             raise RoundInProgress(self.round_name)
         self._reset_state()
+        self._journal(
+            "round_started", round_name=self.round_name, meta=round_meta
+        )
+        self._in_progress = True
+        self.round_meta = round_meta
+        self.started_at = self._clock()
+        return self.round_name
+
+    def resume_round(self, round_name: str, **round_meta: Any) -> str:
+        """Re-open a journal-recovered in-flight round under its original
+        name, so workers still holding trained updates for it can deliver
+        them to the restarted manager. Participants re-join via
+        :meth:`client_start` as the re-announce is acked, exactly like a
+        fresh round."""
+        if self._in_progress:
+            raise RoundInProgress(self.round_name)
+        self._reset_state()
+        self._journal(
+            "round_started", round_name=round_name, meta=round_meta,
+            resumed=True,
+        )
+        self.round_name = round_name
         self._in_progress = True
         self.round_meta = round_meta
         self.started_at = self._clock()
@@ -100,11 +137,27 @@ class RoundManager:
     def client_start(self, client_id: str) -> None:
         if not self._in_progress:
             raise RoundNotInProgress
+        if client_id not in self.clients:
+            self._journal(
+                "round_client_joined",
+                round_name=self.round_name, client_id=client_id,
+            )
         self.clients.add(client_id)
 
     def client_end(self, client_id: str, response: Any) -> None:
         if not self._in_progress:
             raise RoundNotInProgress
+        if isinstance(response, dict):
+            self._journal(
+                "update_accepted",
+                round_name=self.round_name,
+                client_id=client_id,
+                update_id=response.get("update_id"),
+                n_samples=response.get("n_samples"),
+            )
+            uid = response.get("update_id")
+            if uid:
+                self.update_ids[client_id] = uid
         self.client_responses[client_id] = response
 
     def drop_client(self, client_id: str) -> None:
@@ -112,14 +165,24 @@ class RoundManager:
         round can complete without it."""
         if not self._in_progress:
             return
+        if client_id in self.clients:
+            self._journal(
+                "round_client_dropped",
+                round_name=self.round_name, client_id=client_id,
+            )
         self.clients.discard(client_id)
         self.client_responses.pop(client_id, None)
+        self.update_ids.pop(client_id, None)
 
     def end_round(self) -> Dict[str, Any]:
         """Finish the round, returning ``{client_id: response}`` for all
         clients that reported (possibly partial on timeout)."""
         if not self._in_progress:
             raise RoundNotInProgress
+        self._journal(
+            "round_ended",
+            round_name=self.round_name, n_rounds=self.n_rounds + 1,
+        )
         self._in_progress = False
         self.n_rounds += 1
         return self.client_responses
@@ -135,11 +198,14 @@ class RoundManager:
         self.loss_history = list(loss_history)
         self._reset_state()
 
-    def abort_round(self) -> None:
+    def abort_round(self, reason: Optional[str] = None) -> None:
         """Cancel a round without counting it (e.g. no client accepted
         the broadcast — reference manager.py:90-92 path, minus the
         zero-registered-clients lock leak)."""
         if not self._in_progress:
             return
+        self._journal(
+            "round_aborted", round_name=self.round_name, reason=reason
+        )
         self._in_progress = False
         self._reset_state()

@@ -39,6 +39,13 @@ class Rule:
         return self.match in path and (self.times is None or self.hits < self.times)
 
 
+def _match_target(request: web.Request) -> str:
+    """Rules match against path + query string: worker requests carry
+    their identity in the query (``update?client_id=...``), and per-client
+    faults (drop ONE worker's uploads, not the route) need to see it."""
+    return request.path_qs
+
+
 class FaultInjector:
     """Attach to any app (manager or worker) at construction time:
 
@@ -53,7 +60,7 @@ class FaultInjector:
         @web.middleware
         async def middleware(request: web.Request, handler):
             for rule in self.rules:
-                if not rule.applies(request.path):
+                if not rule.applies(_match_target(request)):
                     continue
                 rule.hits += 1
                 if rule.action == "error":

@@ -106,8 +106,15 @@ def test_dropped_update_straggler_watchdog_partial_aggregation():
         exp.rounds.round_timeout = 1.5
         assert exp.metrics.snapshot()["counters"]["updates_received"] == 2
 
-        # exactly one report is lost to a connection reset
-        rule = inj.drop("/lineartest/update", times=1)
+        # ONE worker's reports are persistently lost to connection
+        # resets. A times=1 drop no longer strands a round: the worker's
+        # at-least-once outbox retries past it (test_recovery covers
+        # that); the watchdog path needs a fault that outlasts the
+        # round_timeout, scoped to one client via the query string.
+        straggler = workers[1]
+        rule = inj.drop(
+            f"/lineartest/update?client_id={straggler.client_id}"
+        )
         before = np.asarray(exp.params["w"]).copy()
         history_before = len(exp.rounds.loss_history)
 
@@ -115,7 +122,7 @@ def test_dropped_update_straggler_watchdog_partial_aggregation():
         # the round could not complete normally (one report lost); the
         # watchdog force-finished it within ~round_timeout
         assert sum(acks.values()) == 2
-        assert rule.hits == 1
+        assert rule.hits >= 1
         snap = exp.metrics.snapshot()
         assert snap["counters"]["updates_received"] == 3  # one of two landed
         assert snap["counters"]["rounds_finished"] == 2
@@ -123,7 +130,11 @@ def test_dropped_update_straggler_watchdog_partial_aggregation():
         assert len(exp.rounds.loss_history) == history_before + 2  # n_epoch
         assert not np.allclose(np.asarray(exp.params["w"]), before)
 
-        # the federation is healthy afterwards: a clean round completes
+        # the federation is healthy afterwards: lift the fault — the
+        # straggler's parked update is now stale (its round is over), so
+        # the manager 410s it and the outbox abandons it — and a clean
+        # round completes with both workers
+        inj.clear()
         exp.rounds.round_timeout = 60.0
         await _drive_round(exp, mport, n_epoch=2)
         assert exp.metrics.snapshot()["counters"]["updates_received"] == 5

@@ -1,0 +1,135 @@
+"""Unit tests for the fault-injection middleware itself
+(baton_tpu/utils/faults.py): times= bounding, the drop transport-abort
+path, hits accounting, and query-string matching — the machinery the
+recovery chaos tests (test_recovery.py) lean on."""
+
+import asyncio
+
+import aiohttp
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from baton_tpu.utils.faults import FaultInjector
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _app_with(inj):
+    app = web.Application(middlewares=[inj.middleware])
+
+    async def ok(request):
+        return web.json_response("OK")
+
+    app.router.add_get("/ping", ok)
+    app.router.add_get("/other", ok)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def test_error_rule_times_bounding_and_hits():
+    async def main():
+        inj = FaultInjector()
+        rule = inj.error("/ping", status=503, times=2)
+        client = await _app_with(inj)
+        statuses = [(await client.get("/ping")).status for _ in range(4)]
+        # exactly `times` requests fault, then the rule goes inert
+        assert statuses == [503, 503, 200, 200]
+        assert rule.hits == 2
+        # an exhausted rule no longer counts hits either
+        await client.get("/ping")
+        assert rule.hits == 2
+        await client.close()
+
+    run(main())
+
+
+def test_unbounded_rule_fires_forever():
+    async def main():
+        inj = FaultInjector()
+        rule = inj.error("/ping", status=401)  # times=None
+        client = await _app_with(inj)
+        for _ in range(5):
+            assert (await client.get("/ping")).status == 401
+        assert rule.hits == 5
+        await client.close()
+
+    run(main())
+
+
+def test_rules_scoped_by_substring_match():
+    async def main():
+        inj = FaultInjector()
+        inj.error("/ping", status=500)
+        client = await _app_with(inj)
+        assert (await client.get("/ping")).status == 500
+        assert (await client.get("/other")).status == 200
+        await client.close()
+
+    run(main())
+
+
+def test_query_string_participates_in_matching():
+    """Rules see path + query: per-client faults (one worker's uploads
+    dropped, the rest untouched) key on the client_id in the query."""
+
+    async def main():
+        inj = FaultInjector()
+        rule = inj.error("client_id=w1", status=503)
+        client = await _app_with(inj)
+        assert (await client.get("/ping?client_id=w1&key=k")).status == 503
+        assert (await client.get("/ping?client_id=w2&key=k")).status == 200
+        assert rule.hits == 1
+        await client.close()
+
+    run(main())
+
+
+def test_drop_aborts_transport():
+    """The drop action kills the connection with no HTTP response — the
+    client sees a transport error, never a status."""
+
+    async def main():
+        inj = FaultInjector()
+        rule = inj.drop("/ping", times=1)
+        client = await _app_with(inj)
+        with pytest.raises(aiohttp.ClientError):
+            await client.get("/ping")
+        assert rule.hits == 1
+        # bounded: the next request sails through
+        assert (await client.get("/ping")).status == 200
+        await client.close()
+
+    run(main())
+
+
+def test_delay_rule_delays_then_proceeds():
+    async def main():
+        inj = FaultInjector()
+        rule = inj.delay("/ping", seconds=0.2, times=1)
+        client = await _app_with(inj)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        assert (await client.get("/ping")).status == 200
+        assert loop.time() - t0 >= 0.2
+        assert rule.hits == 1
+        await client.close()
+
+    run(main())
+
+
+def test_clear_removes_all_rules():
+    async def main():
+        inj = FaultInjector()
+        inj.error("/ping", status=500)
+        inj.drop("/other")
+        client = await _app_with(inj)
+        inj.clear()
+        assert (await client.get("/ping")).status == 200
+        assert (await client.get("/other")).status == 200
+        await client.close()
+
+    run(main())

@@ -589,10 +589,17 @@ def test_cohort_fraction_samples_subset_per_round():
                 assert len(acks) == 2 and all(acks.values()), acks
                 cohorts.append(frozenset(acks))
                 for _ in range(200):
-                    if not exp.rounds.in_progress:
+                    # wait for the workers too: a worker that still
+                    # thinks it is mid-round would 409 the next round's
+                    # broadcast (the pre-outbox flake — the flag used to
+                    # clear only after the upload POST round-tripped)
+                    if not exp.rounds.in_progress and not any(
+                        w.round_in_progress for w in workers
+                    ):
                         break
                     await asyncio.sleep(0.05)
                 assert not exp.rounds.in_progress
+                assert not any(w.round_in_progress for w in workers)
 
         # sampling actually varies across rounds (seeded rng, 8 draws
         # of 2-of-4: all-identical has probability (1/6)^7)

@@ -1,0 +1,144 @@
+"""Unit tests for the control-plane write-ahead journal
+(baton_tpu/server/journal.py): event replay, snapshot compaction,
+torn-write tolerance, fsync policy validation."""
+
+import json
+import os
+
+import pytest
+
+from baton_tpu.server.journal import Journal, replay
+
+
+def _j(tmp_path, **kw):
+    return Journal(str(tmp_path / "wal.jsonl"), **kw)
+
+
+def test_fsync_policy_validated(tmp_path):
+    with pytest.raises(ValueError):
+        Journal(str(tmp_path / "w.jsonl"), fsync="sometimes")
+    for ok in ("always", "never", 0.5, 2):
+        Journal(str(tmp_path / f"w{ok}.jsonl"), fsync=ok).close()
+
+
+def test_empty_journal_recovers_empty(tmp_path):
+    with _j(tmp_path) as j:
+        st = j.recover()
+    assert st.empty and not st.clients and st.open_round is None
+
+
+def test_membership_roundtrip(tmp_path):
+    with _j(tmp_path, fsync="never") as j:
+        j.append("client_registered", client_id="a", key="ka",
+                 remote="1.2.3.4", port=80, url="http://x/", registered_at=1.0)
+        j.append("client_registered", client_id="b", key="kb",
+                 remote=None, port=81, url="http://y/", registered_at=2.0)
+        j.append("client_dropped", client_id="a", reason="culled")
+        st = j.recover()
+    assert not st.empty
+    assert set(st.clients) == {"b"}
+    assert st.clients["b"]["key"] == "kb"
+    assert st.clients["b"]["url"] == "http://y/"
+
+
+def test_round_lifecycle_replay(tmp_path):
+    with _j(tmp_path, fsync="never") as j:
+        j.append("client_registered", client_id="a", key="k", url="u",
+                 remote=None, port=1, registered_at=0.0)
+        j.append("round_started", round_name="update_x_00000",
+                 meta={"n_epoch": 4})
+        j.append("round_client_joined", round_name="update_x_00000",
+                 client_id="a")
+        j.append("round_client_joined", round_name="update_x_00000",
+                 client_id="b")
+        j.append("round_client_dropped", round_name="update_x_00000",
+                 client_id="b")
+        j.append("update_accepted", round_name="update_x_00000",
+                 client_id="a", update_id="u1", n_samples=32)
+        st = j.recover()
+        # mid-round crash: the open round comes back with its survivors
+        assert st.open_round is not None
+        assert st.open_round["round_name"] == "update_x_00000"
+        assert st.open_round["meta"] == {"n_epoch": 4}
+        assert st.open_round["participants"] == {"a"}
+        assert st.open_round["accepted"] == {"a": "u1"}
+        assert st.clients["a"]["num_updates"] == 1
+        assert st.clients["a"]["last_update"] == "update_x_00000"
+
+        j.append("round_ended", round_name="update_x_00000", n_rounds=1)
+        st = j.recover()
+        assert st.open_round is None and st.n_rounds == 1
+
+
+def test_aborted_round_not_resumed(tmp_path):
+    with _j(tmp_path, fsync="never") as j:
+        j.append("round_started", round_name="r0", meta={})
+        j.append("round_aborted", round_name="r0", reason="no clients")
+        st = j.recover()
+    assert st.open_round is None and st.n_rounds == 0
+
+
+def test_compaction_snapshot_plus_truncate(tmp_path):
+    with _j(tmp_path, fsync="never") as j:
+        for i in range(5):
+            j.append("client_registered", client_id=f"c{i}", key=f"k{i}",
+                     url="u", remote=None, port=i, registered_at=float(i))
+        j.compact({
+            "clients": {"c9": {"key": "k9", "url": "u", "remote": None,
+                               "port": 9, "registered_at": 9.0,
+                               "num_updates": 3, "last_update": "r"}},
+            "n_rounds": 7,
+            "loss_history": [1.0, 0.5],
+        })
+        # journal truncated: pre-compaction events are gone
+        assert os.path.getsize(j.path) == 0
+        # post-compaction events layer on top of the snapshot
+        j.append("client_registered", client_id="c10", key="k10", url="u",
+                 remote=None, port=10, registered_at=10.0)
+        st = j.recover()
+    assert set(st.clients) == {"c9", "c10"}
+    assert st.clients["c9"]["num_updates"] == 3
+    assert st.n_rounds == 7 and st.loss_history == [1.0, 0.5]
+
+
+def test_torn_final_write_skipped(tmp_path):
+    with _j(tmp_path, fsync="never") as j:
+        j.append("client_registered", client_id="a", key="k", url="u",
+                 remote=None, port=1, registered_at=0.0)
+        j.append("round_started", round_name="r", meta={})
+        # simulate a crash mid-append: a partial JSON line at the tail
+        with open(j.path, "a", encoding="utf-8") as fh:
+            fh.write('{"event": "update_acce')
+        st = j.recover()
+    # the longest valid prefix replays; the torn record is dropped
+    assert set(st.clients) == {"a"}
+    assert st.open_round is not None and st.open_round["round_name"] == "r"
+
+
+def test_unknown_events_ignored(tmp_path):
+    st = replay(None, [
+        {"event": "from_the_future", "x": 1},
+        {"event": "client_registered", "client_id": "a", "key": "k"},
+        {"event": "update_accepted", "client_id": "ghost",
+         "round_name": "r", "update_id": "u"},  # no open round: no-op
+    ])
+    assert set(st.clients) == {"a"} and st.open_round is None
+
+
+def test_snapshot_written_atomically(tmp_path):
+    with _j(tmp_path, fsync="never") as j:
+        j.compact({"clients": {}, "n_rounds": 1, "loss_history": []})
+        # no .tmp left behind, snapshot parses standalone
+        assert not os.path.exists(j.snapshot_path + ".tmp")
+        with open(j.snapshot_path) as fh:
+            assert json.load(fh)["n_rounds"] == 1
+
+
+def test_journal_lines_are_single_json_objects(tmp_path):
+    with _j(tmp_path, fsync="always") as j:
+        j.append("round_started", round_name="r", meta={"n_epoch": 1})
+        with open(j.path) as fh:
+            lines = fh.read().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["event"] == "round_started" and rec["meta"] == {"n_epoch": 1}
