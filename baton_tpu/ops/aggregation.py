@@ -220,6 +220,60 @@ def aggregate_stacked(
     )
 
 
+class StreamingMean:
+    """O(model) streaming FedAvg — fold updates as they arrive.
+
+    The buffered path stacks every cohort member's params before
+    reducing (``O(C × model)`` host memory held until ``end_round``);
+    this accumulator keeps only ``(Σ w_c · x_c, Σ w_c)`` and frees each
+    update's tensors the moment they are folded, so manager memory is
+    flat in cohort size.
+
+    Numerics: accumulation is *sequential fp32 numpy* — deliberately not
+    a tensordot — so the result is a deterministic function of arrival
+    order and bit-matches the reference formula evaluated left-to-right
+    in fp32 (the repo's unit-test oracle). It agrees with
+    :func:`weighted_tree_mean` to fp32 reduction-order tolerance.
+
+    Only valid for the ``"mean"`` aggregator: trimmed mean / coordinate
+    median are order statistics over the full cohort and keep the
+    buffered path (selected by spec in the HTTP manager).
+    """
+
+    def __init__(self) -> None:
+        self._sums: Optional[dict] = None
+        self._weight = np.float32(0.0)
+        self.count = 0
+
+    def add(self, state_dict: dict, weight: float) -> None:
+        """Fold one client's ``{name: array}`` update with sample weight
+        ``weight``. After this returns the caller may drop the tensors."""
+        w = np.float32(weight)
+        if self._sums is None:
+            self._sums = {
+                k: np.asarray(v, np.float32) * w
+                for k, v in state_dict.items()
+            }
+        else:
+            for k, v in state_dict.items():
+                # in-place: no per-update O(model) allocation
+                self._sums[k] += np.asarray(v, np.float32) * w
+        self._weight = self._weight + w
+        self.count += 1
+
+    @property
+    def total_weight(self) -> float:
+        return float(self._weight)
+
+    def mean(self) -> Optional[dict]:
+        """``Σ w·x / max(Σ w, 1e-9)`` as fp32 arrays, or None if nothing
+        was folded. Matches :func:`weighted_tree_mean`'s clamped denom."""
+        if self._sums is None:
+            return None
+        denom = np.maximum(self._weight, np.float32(1e-9))
+        return {k: v / denom for k, v in self._sums.items()}
+
+
 def psum_weighted_scalar_mean(
     values: jax.Array, weights: jax.Array, axis_name: str
 ) -> jax.Array:

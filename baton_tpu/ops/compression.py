@@ -252,3 +252,132 @@ def dequantize_state_dict(tensors: Dict[str, Any]) -> Dict[str, Any]:
     if not out:
         raise ValueError("no quantized tensors found in payload")
     return out
+
+
+# ---------------------------------------------------------------------------
+# broadcast delta blobs — the downlink analogue of the uplink top-k path.
+# The manager encodes prev_round -> this_round as a (sparse and/or
+# quantized) delta ONCE per round; any worker still anchored on the
+# previous round's blob downloads the small delta instead of the full
+# model. Because the delta is lossy, BOTH sides define the round's
+# broadcast as the RECONSTRUCTION ``apply_delta(anchor, delta)`` — pure
+# sequential numpy fp32, bit-identical on manager and worker — so the
+# worker can re-encode its reconstruction and verify it hashes to the
+# round blob's digest (falling back to the full download on mismatch).
+
+
+def parse_delta_spec(spec: str) -> Dict[str, Any]:
+    """``"q8" | "q16" | "topk:<frac>" | "topk:<frac>:q8|q16"`` ->
+    ``{"frac": Optional[float], "bits": Optional[int]}``.
+
+    Mirrors the worker-side upload compression specs so operators tune
+    both directions with one vocabulary."""
+    frac: Optional[float] = None
+    bits: Optional[int] = None
+    parts = spec.split(":")
+    if parts[0] == "topk":
+        if len(parts) not in (2, 3):
+            raise ValueError(f"bad delta spec {spec!r}")
+        frac = float(parts[1])
+        if not (0.0 < frac <= 1.0):
+            raise ValueError(f"delta top-k frac must be in (0, 1], got {frac}")
+        if len(parts) == 3:
+            parts = [parts[2]]
+        else:
+            parts = []
+    if parts:
+        if len(parts) != 1 or parts[0] not in ("q8", "q16"):
+            raise ValueError(
+                f"unknown delta spec {spec!r}; expected 'q8', 'q16', "
+                "'topk:<frac>', or 'topk:<frac>:q8|q16'"
+            )
+        bits = int(parts[0][1:])
+    return {"frac": frac, "bits": bits}
+
+
+def delta_encode_state_dict(
+    prev: Dict[str, Any],
+    new: Dict[str, Any],
+    spec: Dict[str, Any],
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """Encode ``new - prev`` per tensor under a :func:`parse_delta_spec`.
+
+    Flat wire layouts (matching the repo's existing conventions):
+    top-k  -> ``{"<k>@idx": int64[k], "<k>@val": f32[k] | int8/16[k],
+    "<k>@scale": f32[1]}`` (``@scale`` only when quantized); dense
+    quantized -> ``{"<k>@q": intN[shape], "<k>@qscale": f32[1]}``.
+
+    Pure numpy on purpose: the encode runs once per round on the manager
+    host and must not depend on XLA reduction order."""
+    import numpy as np
+
+    frac, bits = spec["frac"], spec["bits"]
+    rng = np.random.default_rng(seed)
+    qmax = float(2 ** ((bits or 8) - 1) - 1)
+    qdtype = np.int8 if (bits or 8) == 8 else np.int16
+
+    def stoch_round(x: "np.ndarray") -> "np.ndarray":
+        lo = np.floor(x)
+        up = rng.random(x.shape, dtype=np.float32) < (x - lo)
+        return np.clip(lo + up, -qmax, qmax)
+
+    out: Dict[str, Any] = {}
+    for k, prev_arr in prev.items():
+        p32 = np.asarray(prev_arr, np.float32).ravel()
+        n32 = np.asarray(new[k], np.float32).ravel()
+        d = n32 - p32
+        if frac is not None:
+            kk = _leaf_k(d.size, frac)
+            idx = np.argpartition(np.abs(d), d.size - kk)[d.size - kk:]
+            idx = np.sort(idx).astype(np.int64)
+            val = d[idx]
+            out[f"{k}@idx"] = idx
+            if bits is not None:
+                scale = max(float(np.max(np.abs(val))), 1e-12) / qmax
+                out[f"{k}@val"] = stoch_round(val / np.float32(scale)).astype(qdtype)
+                out[f"{k}@scale"] = np.asarray([scale], np.float32)
+            else:
+                out[f"{k}@val"] = val.astype(np.float32)
+        elif bits is not None:
+            scale = max(float(np.max(np.abs(d))), 1e-12) / qmax
+            shape = np.asarray(prev_arr).shape
+            out[f"{k}@q"] = (
+                stoch_round(d / np.float32(scale)).astype(qdtype).reshape(shape)
+            )
+            out[f"{k}@qscale"] = np.asarray([scale], np.float32)
+        else:
+            raise ValueError("delta spec must sparsify and/or quantize")
+    return out
+
+
+def apply_delta_state_dict(
+    anchor: Dict[str, Any], delta: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Reconstruct the round broadcast: ``anchor + decode(delta)``.
+
+    Deterministic sequential numpy fp32 (then cast back to each anchor
+    tensor's dtype) so manager and worker reconstructions are
+    bit-identical — that is what makes the worker's digest verification
+    of ``wire.encode(reconstruction)`` meaningful."""
+    import numpy as np
+
+    out: Dict[str, Any] = {}
+    for k, ref in anchor.items():
+        ref_np = np.asarray(ref)
+        ref32 = ref_np.astype(np.float32).ravel()
+        if f"{k}@q" in delta:
+            scale = np.float32(np.asarray(delta[f"{k}@qscale"]).ravel()[0])
+            dense = np.asarray(delta[f"{k}@q"], np.float32).ravel() * scale
+        elif f"{k}@idx" in delta:
+            val = np.asarray(delta[f"{k}@val"], np.float32)
+            if f"{k}@scale" in delta:
+                val = val * np.float32(
+                    np.asarray(delta[f"{k}@scale"]).ravel()[0]
+                )
+            dense = np.zeros(ref32.size, np.float32)
+            dense[np.asarray(delta[f"{k}@idx"], np.int64)] = val
+        else:
+            raise KeyError(f"delta payload missing tensor {k!r}")
+        out[k] = (ref32 + dense).reshape(ref_np.shape).astype(ref_np.dtype)
+    return out

@@ -10,6 +10,23 @@ and status codes, under ``/{experiment}/``:
   GET  end_round                              → round state JSON
   GET  loss_history                           → JSON list
   POST update        ?client_id&key, tensors  → "OK" | 401 | 410
+  GET  round_blob/{digest}  ?client_id&key    → BTW1 bytes | 401 | 404
+                     (v2 pull data plane; supports HTTP Range resume)
+
+Data plane (v2, default): ``start_round`` serializes the round's params
+ONCE into an immutable content-addressed blob (server/blobs.py); each
+cohort member is notified with a small JSON envelope — round meta, blob
+digest, byte size — and pulls the payload from ``round_blob/{digest}``
+with Range-resumable GETs. Workers that still hold the previous round's
+blob ("anchor") are offered a cached delta blob (``broadcast_delta=``,
+computed once per round via ops/compression.py) and reconstruct
+``anchor + delta``, verifying by digest with automatic full-blob
+fallback. ``allow_pickle=True`` keeps the reference push protocol — a
+full pickled body POSTed per client — for stock reference workers.
+Uploads fold into a streaming FedAvg accumulator as they arrive
+(``O(model)`` manager memory; robust aggregators keep the buffered
+path), and every fan-out runs behind a bounded-concurrency gather
+(``fanout_concurrency=``) so C=1024 never means 1024 parallel sockets.
 
 Differences from the reference (each a recorded fix, SURVEY §2.9):
 * loss_history / end_round handlers work (items 1-2 were AttributeErrors).
@@ -46,7 +63,9 @@ broadcast anchor.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
+import re
 from typing import Any, Dict, Optional
 
 import aiohttp
@@ -58,10 +77,11 @@ import numpy as np
 from baton_tpu.core.model import FedModel
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.server import wire
+from baton_tpu.server.blobs import BlobStore
 from baton_tpu.server.registry import AuthError, ClientRegistry, UnknownClient
 from baton_tpu.server.rounds import RoundInProgress, RoundManager
 from baton_tpu.server.state import params_to_state_dict, state_dict_to_params
-from baton_tpu.server.utils import PeriodicTask, json_clean
+from baton_tpu.server.utils import PeriodicTask, bounded_gather, json_clean
 from baton_tpu.utils.metrics import Metrics
 
 DEFAULT_N_EPOCH = 32  # reference manager.py:52-55
@@ -111,9 +131,12 @@ class Experiment:
         secure_scale_bits: int = 16,
         secure_phase_timeout: Optional[float] = None,
         aggregator: str = "mean",
+        streaming_aggregation: bool = True,
         cohort_fraction: float = 1.0,
         min_cohort: int = 1,
         broadcast_quantize_bits: Optional[int] = None,
+        broadcast_delta: Optional[str] = None,
+        fanout_concurrency: int = 64,
         journal_path: Optional[str] = None,
         journal_fsync: Any = "always",
         recovery_policy: str = "resume",
@@ -137,6 +160,30 @@ class Experiment:
         wire. All cohort members dequantize the SAME tensors, so every
         client still starts from identical params, and sparse uplink
         deltas are reconstructed against the dequantized anchor.
+
+        ``broadcast_delta`` (``"q8"`` | ``"q16"`` | ``"topk:<frac>"`` |
+        ``"topk:<frac>:qN"``): downlink delta blobs. Each round the
+        manager additionally encodes prev_round → this_round under this
+        spec, ONCE, and the round's broadcast becomes the (bit-defined)
+        reconstruction ``anchor + delta`` — so a worker holding the
+        previous round's blob downloads only the small delta, verifies
+        its reconstruction by digest, and falls back to the full blob
+        automatically. Mutually exclusive with ``allow_pickle`` (push
+        clients never pull) and ``broadcast_quantize_bits`` (the delta
+        spec already carries the lossy-encoding budget).
+
+        ``streaming_aggregation``: with the ``"mean"`` aggregator, fold
+        each accepted upload into a running ``(weighted_sum, weight)``
+        accumulator and free its tensors immediately — O(model) manager
+        memory regardless of cohort size, bit-identical to the buffered
+        fold (tests/test_dataplane.py). ``False`` keeps the buffered
+        path (per-client state_dicts retained until ``end_round``) for
+        introspection/debugging. Robust aggregators always buffer —
+        order statistics need the whole cohort.
+
+        ``fanout_concurrency``: cap on simultaneous outbound requests
+        for every manager fan-out (notify broadcast, secure phases) —
+        see :func:`baton_tpu.server.utils.bounded_gather`.
 
         ``journal_path``: enable the control-plane write-ahead journal
         (server/journal.py) at this path. On construction the journal is
@@ -162,7 +209,37 @@ class Experiment:
                 "reference-protocol workers cannot dequantize"
             )
         self.broadcast_quantize_bits = broadcast_quantize_bits
+        self._delta_spec: Optional[dict] = None
+        if broadcast_delta is not None:
+            if allow_pickle:
+                raise ValueError(
+                    "broadcast_delta is incompatible with allow_pickle: "
+                    "reference-protocol workers use the push path and "
+                    "never pull blobs"
+                )
+            if broadcast_quantize_bits is not None:
+                raise ValueError(
+                    "broadcast_delta and broadcast_quantize_bits are "
+                    "mutually exclusive: the delta spec already carries "
+                    "the lossy-encoding budget"
+                )
+            from baton_tpu.ops.compression import parse_delta_spec
+
+            self._delta_spec = parse_delta_spec(broadcast_delta)
+        if fanout_concurrency < 1:
+            raise ValueError(
+                f"fanout_concurrency must be >= 1, got {fanout_concurrency}"
+            )
+        self.fanout_concurrency = int(fanout_concurrency)
         self._broadcast_anchor_sd: Optional[dict] = None
+        # v2 pull data plane: content-addressed blobs + delta anchoring
+        self._blobs = BlobStore()
+        self._prev_blob_sd: Optional[dict] = None
+        self._prev_blob_digest: Optional[str] = None
+        # streaming FedAvg accumulator for the round in flight (None for
+        # robust/secure rounds, which need the buffered path)
+        self._stream_acc = None
+        self.streaming_aggregation = bool(streaming_aggregation)
         if not (0.0 < cohort_fraction <= 1.0):
             raise ValueError(
                 f"cohort_fraction must be in (0, 1], got {cohort_fraction}"
@@ -327,25 +404,35 @@ class Experiment:
             "%s: resuming round %s with %d participants",
             self.name, round_name, len(cohort),
         )
-        # resumed broadcasts are always dense: the quantization seed and
-        # anchor of the original broadcast died with the old process, and
-        # a different anchor would corrupt sparse-delta reconstruction
+        # resumed broadcasts are always dense (never delta-encoded): the
+        # quantization seed and blob anchor of the original broadcast
+        # died with the old process, and a different anchor would
+        # corrupt sparse-delta reconstruction
         state_dict = {
-            k: np.asarray(v)
+            k: np.ascontiguousarray(np.asarray(v))
             for k, v in params_to_state_dict(self.params).items()
         }
         self._broadcast_anchor_sd = state_dict
-        meta_out = {"update_name": round_name, "n_epoch": n_epoch}
+        self._stream_acc = (
+            agg.StreamingMean()
+            if self.streaming_aggregation and self.aggregator[0] == "mean"
+            else None
+        )
         if self.allow_pickle:
+            meta_out = {"update_name": round_name, "n_epoch": n_epoch}
             body = wire.encode_pickle(state_dict, meta_out)
             ctype = wire.PICKLE_CONTENT_TYPE
         else:
-            body = wire.encode(state_dict, meta_out)
-            ctype = wire.CONTENT_TYPE
+            envelope = self._publish_round_blobs(
+                round_name, n_epoch, state_dict, None, None
+            )
+            body = json.dumps(envelope).encode()
+            ctype = "application/json"
         self._broadcasting = True
         try:
-            await asyncio.gather(
-                *[self._notify_client(cid, body, ctype) for cid in cohort]
+            await bounded_gather(
+                *[self._notify_client(cid, body, ctype) for cid in cohort],
+                limit=self.fanout_concurrency,
             )
         finally:
             self._broadcasting = False
@@ -423,6 +510,59 @@ class Experiment:
         r.add_get(f"/{self.name}/loss_history", self.handle_loss_history)
         r.add_post(f"/{self.name}/update", self.handle_update)
         r.add_get(f"/{self.name}/metrics", self.handle_metrics)
+        r.add_get(
+            f"/{self.name}/round_blob/{{digest}}", self.handle_round_blob
+        )
+
+    # -- v2 pull data plane --------------------------------------------
+    _RANGE_RE = re.compile(r"bytes=(\d+)-(\d*)$")
+
+    async def handle_round_blob(self, request: web.Request) -> web.Response:
+        """Serve an immutable round blob, with single-range resume.
+
+        Only ``bytes=<start>-[<end>]`` ranges are honored (that is the
+        resume shape); anything else is 416. The blob is immutable under
+        its digest, so a resumed download never needs If-Range
+        validation — the ETag IS the URL."""
+        try:
+            self.registry.verify(
+                request.query.get("client_id", ""),
+                request.query.get("key", ""),
+            )
+        except (UnknownClient, AuthError):
+            return web.json_response({"err": "Unauthorized"}, status=401)
+        digest = request.match_info["digest"]
+        entry = self._blobs.get(digest)
+        if entry is None:
+            # round rolled and retention dropped it — the worker falls
+            # back to whatever the CURRENT round's envelope names
+            return web.json_response({"err": "Unknown Blob"}, status=404)
+        data, kind = entry
+        total = len(data)
+        headers = {"Accept-Ranges": "bytes", "ETag": f'"{digest}"'}
+        status, start, end = 200, 0, total
+        range_hdr = request.headers.get("Range")
+        if range_hdr is not None:
+            m = self._RANGE_RE.match(range_hdr.strip())
+            if m:
+                start = int(m.group(1))
+                end = int(m.group(2)) + 1 if m.group(2) else total
+            if not m or start >= end or end > total:
+                headers["Content-Range"] = f"bytes */{total}"
+                return web.Response(status=416, headers=headers)
+            status = 206
+            headers["Content-Range"] = f"bytes {start}-{end - 1}/{total}"
+            if start > 0:
+                self.metrics.inc("range_resumes")
+        payload = data[start:end]
+        self.metrics.inc("bytes_broadcast", len(payload))
+        self.metrics.inc(
+            "blob_hits_delta" if kind == "delta" else "blob_hits_full"
+        )
+        return web.Response(
+            body=payload, status=status,
+            content_type=wire.CONTENT_TYPE, headers=headers,
+        )
 
     # -- membership ----------------------------------------------------
     async def handle_register(self, request: web.Request) -> web.Response:
@@ -486,6 +626,7 @@ class Experiment:
         except (UnknownClient, AuthError):
             return web.json_response({"err": "Unauthorized"}, status=401)
         body = await request.read()
+        self.metrics.inc("bytes_uploaded", len(body))
         try:
             tensors, meta = wire.decode_any(
                 body, request.content_type, allow_pickle=self.allow_pickle
@@ -569,6 +710,14 @@ class Experiment:
             # would double this client's sample weight in the aggregate.
             self.metrics.inc("duplicate_updates_deduped")
             return web.json_response("OK")
+        if client_id in self.rounds.client_responses:
+            # a DIFFERENT update from a client whose first update was
+            # already accepted: the first accepted update per client per
+            # round is FINAL — its 200 ack promised it counts, and under
+            # streaming aggregation it is already folded into the running
+            # sum and cannot be retracted. Ack without recounting.
+            self.metrics.inc("repeat_updates_ignored")
+            return web.json_response("OK")
         if compressed_anchor is not None:
             # reconstruct AFTER the round checks: the anchor (this
             # round's broadcast == self.params, unchanged until
@@ -576,16 +725,30 @@ class Experiment:
             # uploads were already 410'd above
             tensors = self._decompress_upload(tensors, compressed_anchor)
             self.metrics.inc("compressed_updates_received")
-        self.rounds.client_end(
-            client_id,
-            {
-                "state_dict": tensors,
-                "masked": bool(meta.get("secure", False)),
-                "n_samples": meta_n_samples,
-                "loss_history": meta_losses,
-                "update_id": update_id,
-            },
-        )
+        response = {
+            "masked": bool(meta.get("secure", False)),
+            "n_samples": meta_n_samples,
+            "loss_history": meta_losses,
+            "update_id": update_id,
+        }
+        if self._stream_acc is not None and not response["masked"]:
+            # streaming FedAvg: fold NOW and free the tensors — manager
+            # memory stays O(model) no matter the cohort size. Restrict
+            # to the round anchor's keys so a surplus tensor in an
+            # upload cannot enter the running sums.
+            anchor = (
+                self._broadcast_anchor_sd
+                if self._broadcast_anchor_sd is not None
+                else params_to_state_dict(self.params)
+            )
+            self._stream_acc.add(
+                {k: tensors[k] for k in anchor}, meta_n_samples
+            )
+            response["streamed"] = True
+        else:
+            response["state_dict"] = tensors
+        del tensors
+        self.rounds.client_end(client_id, response)
         self.registry.record_update(client_id, round_name)
         self.metrics.inc("updates_received")
         self._maybe_finish()
@@ -686,8 +849,22 @@ class Experiment:
             # Fix of SURVEY §2.9 item 3: abort releases the round.
             self.rounds.abort_round()
             return {}
+        # streaming FedAvg: created BEFORE any notify so a fast worker's
+        # upload (which can land mid-broadcast) has somewhere to fold.
+        # Robust aggregators are order statistics over the whole cohort
+        # and secure rounds only ever yield a masked SUM — both keep the
+        # buffered path (self._stream_acc stays None).
+        self._stream_acc = (
+            agg.StreamingMean()
+            if self.streaming_aggregation
+            and self.aggregator[0] == "mean"
+            and not self.secure_agg
+            else None
+        )
         state_dict = params_to_state_dict(self.params)
         meta = {"update_name": round_name, "n_epoch": n_epoch}
+        encoding = None
+        delta_tensors = None
         if self.broadcast_quantize_bits is not None:
             from baton_tpu.ops.compression import (
                 dequantize_state_dict,
@@ -702,6 +879,7 @@ class Experiment:
                 ).items()
             }
             meta["quantized"] = {"bits": bits}
+            encoding = {"quantized": {"bits": bits}}
             # sparse uplink deltas are computed against what the clients
             # actually LOADED — the dequantized broadcast ROUND-TRIPPED
             # through the model's param dtypes (state_dict_to_params
@@ -713,22 +891,43 @@ class Experiment:
                 )
             )
         else:
+            state_dict = {
+                k: np.ascontiguousarray(np.asarray(v))
+                for k, v in state_dict.items()
+            }
+            if self._delta_spec is not None and self._prev_blob_sd is not None:
+                from baton_tpu.ops.compression import (
+                    apply_delta_state_dict,
+                    delta_encode_state_dict,
+                )
+
+                # the round's broadcast is DEFINED as the reconstruction
+                # anchor + delta (bit-identical numpy on both sides) so
+                # anchored workers and full-blob workers load the exact
+                # same tensors — the worker verifies by re-encoding its
+                # reconstruction and hashing it against the blob digest
+                delta_tensors = delta_encode_state_dict(
+                    self._prev_blob_sd, state_dict, self._delta_spec,
+                    seed=self.rounds.n_rounds,
+                )
+                state_dict = apply_delta_state_dict(
+                    self._prev_blob_sd, delta_tensors
+                )
             # materialize the round anchor ONCE here, not per upload:
             # self.params is invariant until end_round, and a per-upload
             # params_to_state_dict is a full-model device-to-host copy
-            self._broadcast_anchor_sd = {
-                k: np.asarray(v) for k, v in state_dict.items()
-            }
+            self._broadcast_anchor_sd = state_dict
         cohort_ids = self._sample_cohort()
         if self.secure_agg:
             # Bonawitz round 0 (AdvertiseKeys): per-round DH key
             # agreement. Clients that fail are excluded BEFORE the pk
             # directory circulates.
-            pk_results = await asyncio.gather(
+            pk_results = await bounded_gather(
                 *[
                     self._collect_pk(cid, round_name)
                     for cid in cohort_ids
-                ]
+                ],
+                limit=self.fanout_concurrency,
             )
             pks = {cid: p for cid, p in pk_results if p is not None}
             if not pks:
@@ -749,11 +948,12 @@ class Experiment:
             # the round_start broadcast. Members that fail here never
             # distributed shares, so nobody may mask toward them — the
             # masking cohort is exactly the successful sharers.
-            share_results = await asyncio.gather(
+            share_results = await bounded_gather(
                 *[
                     self._collect_shares(cid, round_name, pks, t)
                     for cid in cohort_a
-                ]
+                ],
+                limit=self.fanout_concurrency,
             )
             outboxes = {cid: m for cid, m in share_results if m is not None}
             cohort = sorted(outboxes)
@@ -789,16 +989,6 @@ class Experiment:
                 # inbox is per-recipient — filled in the broadcast loop
             }
             self._secure_outboxes = outboxes
-        if self.allow_pickle:
-            # Reference-protocol broadcast (manager.py:77-86): stock
-            # reference workers can only decode pickled state_dicts, so
-            # an allow_pickle experiment speaks pickle in BOTH directions
-            # — uploads were already accepted via wire.decode_any.
-            body = wire.encode_pickle(state_dict, meta)
-            ctype = wire.PICKLE_CONTENT_TYPE
-        else:
-            body = wire.encode(state_dict, meta)
-            ctype = wire.CONTENT_TYPE
         # Participation is recorded inside _notify_client the moment a
         # client acks — NOT after the gather. A fast worker can train and
         # upload before slower notifies finish; recording late would let
@@ -806,29 +996,49 @@ class Experiment:
         # this exact race, manager.py:87-89). _broadcasting additionally
         # keeps _maybe_finish from ending/aborting the round while acks
         # are still arriving.
-        if self._secure_round is not None:
-            # per-recipient bodies: each cohort member's broadcast
-            # carries ITS inbox of sealed share boxes from the others
-            recipients = self._secure_round["cohort"]
-            outboxes = self._secure_outboxes
-            bodies = {}
-            for cid in recipients:
-                inbox = {
-                    sender: outboxes[sender].get(cid)
-                    for sender in recipients
-                    if sender != cid and outboxes[sender].get(cid)
-                }
-                m = dict(meta)
-                m["secure"] = dict(meta["secure"], inbox=inbox)
-                bodies[cid] = wire.encode(state_dict, m)
-        else:
-            recipients = cohort_ids
-            bodies = {cid: body for cid in recipients}
-        results = await asyncio.gather(
-            *[
-                self._notify_client(cid, bodies[cid], ctype)
-                for cid in recipients
+        if self.allow_pickle:
+            # Reference-protocol PUSH broadcast (manager.py:77-86): stock
+            # reference workers can only decode pickled state_dicts, so
+            # an allow_pickle experiment speaks pickle in BOTH directions
+            # — uploads were already accepted via wire.decode_any.
+            body = wire.encode_pickle(state_dict, meta)
+            coros = [
+                self._notify_client(cid, body, wire.PICKLE_CONTENT_TYPE)
+                for cid in cohort_ids
             ]
+        else:
+            # v2 PULL broadcast: serialize the round params ONCE into a
+            # content-addressed blob; notify bodies are tiny envelopes.
+            envelope = self._publish_round_blobs(
+                round_name, n_epoch, state_dict, delta_tensors, encoding
+            )
+            if self._secure_round is not None:
+                # per-recipient envelopes: each cohort member's carries
+                # ITS inbox of sealed share boxes from the others
+                recipients = self._secure_round["cohort"]
+                outboxes = self._secure_outboxes
+                coros = []
+                for cid in recipients:
+                    inbox = {
+                        sender: outboxes[sender].get(cid)
+                        for sender in recipients
+                        if sender != cid and outboxes[sender].get(cid)
+                    }
+                    env = dict(envelope)
+                    env["secure"] = dict(meta["secure"], inbox=inbox)
+                    coros.append(self._notify_client(
+                        cid, json.dumps(env).encode(), "application/json"
+                    ))
+            else:
+                # ONE shared body for the whole cohort — no per-recipient
+                # dict: a 1024-cohort round holds one reference
+                shared = json.dumps(envelope).encode()
+                coros = [
+                    self._notify_client(cid, shared, "application/json")
+                    for cid in cohort_ids
+                ]
+        results = await bounded_gather(
+            *coros, limit=self.fanout_concurrency
         )
 
         if self.simulator is not None:
@@ -845,6 +1055,45 @@ class Experiment:
             self.rounds.abort_round()
             self._secure_round = None
         return dict(results)
+
+    def _publish_round_blobs(
+        self, round_name, n_epoch, state_dict, delta_tensors, encoding
+    ) -> dict:
+        """Encode the round's tensors ONCE into the blob store and build
+        the v2 notify envelope. Retention keeps exactly this round's
+        full blob, its delta blob, and the previous full blob (a
+        straggler may still be mid-download when the round rolls)."""
+        full_blob = wire.encode(state_dict, {})
+        full_digest = self._blobs.put(full_blob, kind="full")
+        envelope: Dict[str, Any] = {
+            "v": 2,
+            "update_name": round_name,
+            "n_epoch": n_epoch,
+            "blob": {"digest": full_digest, "size": len(full_blob)},
+        }
+        if encoding is not None:
+            envelope["encoding"] = encoding
+        keep = [full_digest, self._prev_blob_digest]
+        if delta_tensors is not None and full_digest != self._prev_blob_digest:
+            delta_blob = wire.encode(delta_tensors, {})
+            delta_digest = self._blobs.put(delta_blob, kind="delta")
+            envelope["delta"] = {
+                "digest": delta_digest,
+                "size": len(delta_blob),
+                "from": self._prev_blob_digest,
+            }
+            keep.append(delta_digest)
+        self._blobs.retain(keep)
+        if encoding is None:
+            # dense broadcasts anchor the next round's delta; quantized
+            # ones don't (their tensors are @q layouts the delta path
+            # doesn't speak, and the stochastic seed changes per round)
+            self._prev_blob_sd = state_dict
+            self._prev_blob_digest = full_digest
+        else:
+            self._prev_blob_sd = None
+            self._prev_blob_digest = None
+        return envelope
 
     def _sample_cohort(self) -> list:
         """The round's notification cohort: all registered clients at
@@ -987,6 +1236,7 @@ class Experiment:
                 url, data=body, headers={"Content-Type": content_type},
                 **post_kw,
             ) as resp:
+                self.metrics.inc("bytes_broadcast", len(body))
                 if resp.status == 200:
                     # record participation NOW, before yielding back to
                     # the loop — this client may upload its update at any
@@ -1082,14 +1332,21 @@ class Experiment:
             return
         if not self.rounds.in_progress or self.rounds.round_name != round_name:
             return  # round was force-ended meanwhile
-        self.rounds.client_end(
-            "__simulated__",
-            {
-                "state_dict": params_to_state_dict(result.params),
-                "n_samples": float(result.n_samples_total),
-                "loss_history": [float(x) for x in np.asarray(result.loss_history)],
-            },
-        )
+        response = {
+            "n_samples": float(result.n_samples_total),
+            "loss_history": [float(x) for x in np.asarray(result.loss_history)],
+        }
+        result_sd = params_to_state_dict(result.params)
+        if self._stream_acc is not None:
+            # the simulated cohort streams like any other participant
+            self._stream_acc.add(
+                {k: np.asarray(v) for k, v in result_sd.items()},
+                response["n_samples"],
+            )
+            response["streamed"] = True
+        else:
+            response["state_dict"] = result_sd
+        self.rounds.client_end("__simulated__", response)
         self._maybe_finish()
 
     def _validate_masked_upload(self, tensors, meta) -> None:
@@ -1149,19 +1406,32 @@ class Experiment:
             return
         n_epoch = (self.rounds.round_meta or {}).get("n_epoch", 0)
         self.metrics.observe("round_s", self.rounds.elapsed)
+        acc, self._stream_acc = self._stream_acc, None
         responses = self.rounds.end_round()
         self.metrics.inc("rounds_finished")
         reports = [r for r in responses.values() if r.get("n_samples", 0) > 0]
         if not reports:
             return
-        weights = jnp.asarray([r["n_samples"] for r in reports], jnp.float32)
-        template = params_to_state_dict(self.params)
-        stacked = {
-            k: jnp.stack([np.asarray(r["state_dict"][k]) for r in reports])
-            for k in template
-        }
-        merged = agg.apply_aggregator(self.aggregator, stacked, weights)
-        self.params = state_dict_to_params(self.params, {k: np.asarray(v) for k, v in merged.items()})
+        if acc is not None:
+            # streaming FedAvg: the per-update tensors were folded (and
+            # freed) in handle_update — the merge is one division
+            merged = acc.mean()
+            if merged is None:
+                return
+            self.params = state_dict_to_params(self.params, merged)
+        else:
+            weights = jnp.asarray(
+                [r["n_samples"] for r in reports], jnp.float32
+            )
+            template = params_to_state_dict(self.params)
+            stacked = {
+                k: jnp.stack([np.asarray(r["state_dict"][k]) for r in reports])
+                for k in template
+            }
+            merged = agg.apply_aggregator(self.aggregator, stacked, weights)
+            self.params = state_dict_to_params(
+                self.params, {k: np.asarray(v) for k, v in merged.items()}
+            )
         self._record_history_and_checkpoint(reports, n_epoch)
 
     async def _end_round_secure(self) -> None:
@@ -1210,14 +1480,15 @@ class Experiment:
                 self._secure_round = None
                 return
             template = params_to_state_dict(self.params)
-            bundles = await asyncio.gather(
+            bundles = await bounded_gather(
                 *[
                     self._request_unmask(
                         rid, sr["round_name"], survivors, dropped,
                         sr["c_pks"][rid],
                     )
                     for rid in survivors
-                ]
+                ],
+                limit=self.fanout_concurrency,
             )
             # collect shares by secret owner; x-indices were fixed at
             # share time, so partial responses compose correctly

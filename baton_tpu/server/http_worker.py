@@ -21,6 +21,13 @@ train locally, POST the result to ``update`` — with the recorded fixes
   manager dedupes redelivery (a 200 lost in transit must not
   double-count the client's samples in the aggregate).
 * Weights travel as BTW1 tensors, not pickles (pickle decode opt-in).
+* Pull data plane (v2): ``round_start`` delivers a small JSON envelope
+  naming the round blob by sha256 digest; the worker fetches it from
+  ``GET /{name}/round_blob/{digest}`` with HTTP Range resume across
+  connection drops, or reconstructs it from the previous round's
+  anchor plus a delta blob when the manager offers one (full-blob
+  fallback on any digest mismatch). Legacy whole-model push bodies are
+  still accepted on the same route.
 * Mid-training visibility (reference utils.py:70-91 streams tqdm batch
   progress + a running loss): the jitted multi-epoch run reports each
   finished epoch from inside XLA via an ``io_callback`` progress hook
@@ -37,6 +44,10 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import hashlib
+import json
+import os
+import pathlib
 import random
 import secrets
 import weakref
@@ -119,6 +130,7 @@ class ExperimentWorker:
         auto_register: bool = True,
         compress: Optional[str] = None,
         outbox_backoff: Tuple[float, float] = (0.25, 10.0),
+        outbox_dir: Optional[str] = None,
     ):
         """``compress`` turns on sparse round-delta uploads
         (ops/compression.py): ``"topk:0.05"`` keeps the top 5% of delta
@@ -127,7 +139,15 @@ class ExperimentWorker:
         Ignored for secure rounds (masking needs dense ring elements).
 
         ``outbox_backoff``: ``(base, cap)`` seconds for the upload retry
-        schedule — capped exponential with jitter."""
+        schedule — capped exponential with jitter.
+
+        ``outbox_dir``: persist the one-slot outbox to disk (the encoded
+        upload body as a BTW1 file + a meta JSON). A worker that crashes
+        between training and delivery reloads the slot on startup and
+        delivers the round's work after restart — closing the ROADMAP
+        worker-crash gap. The error-feedback compressor residual is NOT
+        persisted: after a crash-reload an abandoned update's kept mass
+        cannot be folded back (only delayed-delivery is durable)."""
         self.name = name or getattr(model, "name", "fedmodel")
         self.model = model
         self.metrics = Metrics()
@@ -149,6 +169,11 @@ class ExperimentWorker:
         self.allow_pickle = allow_pickle
         self.compressor = _parse_compress(compress, seed=rng_seed)
         self._round_anchor: Optional[dict] = None
+        # v2 pull data plane: the last dense round blob we hold, by
+        # digest — advertised implicitly (the manager's envelope names
+        # the delta's base digest; we apply it only if it matches)
+        self._anchor_sd: Optional[dict] = None
+        self._anchor_digest: Optional[str] = None
         if get_data is not None:
             self.get_data = get_data  # type: ignore[assignment]
 
@@ -160,7 +185,11 @@ class ExperimentWorker:
         self.n_updates = 0
         self.round_in_progress = False
         self.outbox_backoff = outbox_backoff
-        self._pending: Optional[_PendingUpdate] = None
+        self.outbox_dir = outbox_dir
+        self._pending: Optional[_PendingUpdate] = self._load_persisted()
+        if self._pending is not None:
+            self.metrics.set_gauge("outbox_pending", 1)
+            self.metrics.inc("outbox_reloaded_from_disk")
         self._outbox_task: Optional[asyncio.Task] = None
         # guards the broadcast handler's await windows (body read, boxed-
         # share decryption in a worker thread): a duplicate round_start
@@ -189,6 +218,13 @@ class ExperimentWorker:
 
     async def _on_startup(self, app=None) -> None:
         asyncio.ensure_future(self.register_with_manager())
+        if self._pending is not None and (
+            self._outbox_task is None or self._outbox_task.done()
+        ):
+            # a disk-reloaded outbox slot: deliver the pre-crash round's
+            # trained update as soon as registration lands (the drain
+            # loop's 401 path re-registers as needed)
+            self._outbox_task = asyncio.ensure_future(self._drain_outbox())
 
     async def _on_cleanup(self, app=None) -> None:
         if self._heartbeat_task is not None:
@@ -494,6 +530,11 @@ class ExperimentWorker:
         self, request: web.Request
     ) -> web.Response:
         body = await request.read()
+        if request.content_type == "application/json" or body[:1] == b"{":
+            # v2 pull protocol: the notify body is a small JSON envelope;
+            # the round payload is fetched from the manager's blob store
+            return await self._handle_round_start_envelope(body)
+        # legacy push protocol: the full round payload IS the body
         try:
             tensors, meta = wire.decode_any(
                 body, request.content_type, allow_pickle=self.allow_pickle
@@ -511,7 +552,159 @@ class ExperimentWorker:
             # reject before mutating any state: a bad broadcast must not
             # leave the worker with half-loaded params
             return web.json_response({"err": "Bad Payload"}, status=400)
-        secure_info = meta.get("secure")
+        return await self._accept_broadcast(
+            round_name, n_epoch, new_params, meta.get("secure")
+        )
+
+    async def _handle_round_start_envelope(self, body: bytes) -> web.Response:
+        """v2 notify: parse the envelope, obtain the round tensors (anchor
+        reuse → delta reconstruction → full blob, in fallback order),
+        then accept like any broadcast."""
+        try:
+            env = json.loads(body.decode("utf-8"))
+            round_name = str(env["update_name"])
+            n_epoch = int(env["n_epoch"])
+            digest = str(env["blob"]["digest"])
+            size = int(env["blob"]["size"])
+            encoding = env.get("encoding") or {}
+            delta_info = env.get("delta")
+        except Exception:
+            return web.json_response({"err": "Bad Envelope"}, status=400)
+        tensors = await self._obtain_round_tensors(digest, size, delta_info)
+        if tensors is None:
+            # the manager's bounded notify fan-out naturally backpressures
+            # these downloads; a 503 here lets it count the miss and
+            # exclude us this round instead of hanging the broadcast
+            return web.json_response({"err": "Blob Unavailable"}, status=503)
+        try:
+            load = tensors
+            if encoding.get("quantized"):
+                from baton_tpu.ops.compression import dequantize_state_dict
+
+                load = dequantize_state_dict(tensors)
+            new_params = state_dict_to_params(self.params, load)
+        except Exception:
+            return web.json_response({"err": "Bad Payload"}, status=400)
+        if not encoding:
+            # dense blobs anchor the next round's delta; encoded blobs
+            # (@q layouts) are not valid delta bases
+            self._anchor_sd = tensors
+            self._anchor_digest = digest
+        else:
+            self._anchor_sd = None
+            self._anchor_digest = None
+        return await self._accept_broadcast(
+            round_name, n_epoch, new_params, env.get("secure")
+        )
+
+    async def _obtain_round_tensors(
+        self, digest: str, size: int, delta_info
+    ) -> Optional[dict]:
+        """The pull side of the data plane, cheapest source first:
+
+        1. digest matches the anchor we already hold → no download;
+        2. the envelope offers a delta FROM our anchor → fetch the small
+           delta blob, reconstruct ``anchor + delta``, and verify the
+           reconstruction re-encodes to the round blob's digest;
+        3. otherwise (fresh worker, stale anchor, or verification
+           failure) → fetch the full blob (Range-resumable).
+        """
+        if self._anchor_sd is not None and self._anchor_digest == digest:
+            self.metrics.inc("blob_reused_anchor")
+            return dict(self._anchor_sd)
+        if (
+            delta_info
+            and self._anchor_sd is not None
+            and delta_info.get("from") == self._anchor_digest
+        ):
+            try:
+                ddigest = str(delta_info["digest"])
+                dsize = int(delta_info["size"])
+            except (KeyError, TypeError, ValueError):
+                ddigest = None
+            draw = (
+                await self._fetch_blob(ddigest, dsize)
+                if ddigest is not None
+                else None
+            )
+            if draw is not None:
+                from baton_tpu.ops.compression import apply_delta_state_dict
+
+                try:
+                    delta_tensors, _ = wire.decode(draw)
+                    cand = apply_delta_state_dict(
+                        self._anchor_sd, delta_tensors
+                    )
+                    if (
+                        hashlib.sha256(wire.encode(cand, {})).hexdigest()
+                        == digest
+                    ):
+                        self.metrics.inc("blob_fetch_delta")
+                        return cand
+                except Exception:
+                    pass
+                # reconstruction didn't hash to the round blob (anchor
+                # drift, corrupt delta): fall through to the full blob
+                self.metrics.inc("blob_delta_digest_mismatch")
+        raw = await self._fetch_blob(digest, size)
+        if raw is None:
+            self.metrics.inc("blob_fetch_failed")
+            return None
+        try:
+            tensors, _ = wire.decode(raw)
+        except Exception:
+            self.metrics.inc("blob_fetch_failed")
+            return None
+        self.metrics.inc("blob_fetch_full")
+        return tensors
+
+    async def _fetch_blob(
+        self, digest: str, size: int, max_attempts: int = 6
+    ) -> Optional[bytes]:
+        """GET a content-addressed blob, resuming interrupted transfers
+        with HTTP Range and verifying the assembled bytes by digest."""
+        url = (
+            self.manager_url
+            + f"round_blob/{digest}?client_id={self.client_id}&key={self.key}"
+        )
+        buf = bytearray()
+        base, cap = 0.2, 2.0
+        for attempt in range(max_attempts):
+            headers = {}
+            if buf:
+                # the blob is immutable under its digest, so a partial
+                # body resumes where it stopped instead of restarting
+                headers["Range"] = f"bytes={len(buf)}-"
+                self.metrics.inc("blob_range_resumes")
+            try:
+                async with self._session.get(url, headers=headers) as resp:
+                    if resp.status == 200 and buf:
+                        buf.clear()  # server ignored the Range: restart
+                    if resp.status in (200, 206):
+                        async for chunk in resp.content.iter_chunked(1 << 16):
+                            buf.extend(chunk)
+                    elif resp.status in (404, 410):
+                        return None  # blob gone (round rolled): give up
+                    else:
+                        buf.clear()  # 416/401/5xx: restart clean
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                pass  # partial body stays in buf; next attempt resumes
+            if len(buf) == size:
+                if hashlib.sha256(buf).hexdigest() == digest:
+                    return bytes(buf)
+                buf.clear()  # corrupt assembly: restart from scratch
+            elif len(buf) > size:
+                buf.clear()
+            if attempt < max_attempts - 1:
+                delay = min(base * (2 ** attempt), cap)
+                await asyncio.sleep(delay * (0.5 + random.random() / 2))
+        return None
+
+    async def _accept_broadcast(
+        self, round_name: str, n_epoch: int, new_params, secure_info
+    ) -> web.Response:
+        """Common tail for both broadcast protocols: open the secure
+        inbox if the round is masked, load params, and spawn the round."""
         if secure_info is not None:
             st = self._secure.get(round_name)
             if st is None or "cohort" not in st:
@@ -732,18 +925,82 @@ class ExperimentWorker:
         )
 
     # -- at-least-once outbox ------------------------------------------
+    def _outbox_paths(self) -> Tuple[pathlib.Path, pathlib.Path]:
+        d = pathlib.Path(self.outbox_dir)
+        return d / "outbox.body", d / "outbox.json"
+
+    def _persist_pending(self, p: _PendingUpdate) -> None:
+        """Write the outbox slot to disk: body first, then the meta JSON
+        via tmp-file + ``os.replace`` — the meta rename is the commit
+        point, so a crash mid-write leaves either a complete slot or no
+        slot, never a half one."""
+        if self.outbox_dir is None:
+            return
+        body_path, meta_path = self._outbox_paths()
+        body_path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = body_path.with_suffix(".body.tmp")
+        tmp.write_bytes(p.body)
+        os.replace(tmp, body_path)
+        meta = {
+            "round_name": p.round_name,
+            "update_id": p.update_id,
+            "body_len": len(p.body),
+        }
+        tmp = meta_path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(meta))
+        os.replace(tmp, meta_path)
+
+    def _clear_persisted(self) -> None:
+        if self.outbox_dir is None:
+            return
+        for path in self._outbox_paths():
+            try:
+                path.unlink()
+            except FileNotFoundError:
+                pass
+
+    def _load_persisted(self) -> Optional[_PendingUpdate]:
+        """Reload a crash-survived outbox slot, if the on-disk pair is
+        complete and consistent (meta committed, body the advertised
+        length, BTW1 magic intact). Anything short of that is treated as
+        no slot — delivery is at-least-once, never garbage."""
+        if self.outbox_dir is None:
+            return None
+        body_path, meta_path = self._outbox_paths()
+        try:
+            meta = json.loads(meta_path.read_text())
+            body = body_path.read_bytes()
+        except (FileNotFoundError, ValueError, OSError):
+            return None
+        if (
+            not isinstance(meta, dict)
+            or len(body) != meta.get("body_len")
+            or body[:4] != wire.MAGIC
+        ):
+            return None
+        try:
+            return _PendingUpdate(
+                round_name=str(meta["round_name"]),
+                update_id=str(meta["update_id"]),
+                body=body,
+            )
+        except KeyError:
+            return None
+
     def _enqueue_update(self, pending: _PendingUpdate) -> None:
         # one slot: a newer round's update supersedes anything still
         # undelivered (the manager 410s stale rounds anyway)
         if self._pending is not None:
             self._cancel_pending("superseded")
         self._pending = pending
+        self._persist_pending(pending)
         self.metrics.set_gauge("outbox_pending", 1)
         if self._outbox_task is None or self._outbox_task.done():
             self._outbox_task = asyncio.ensure_future(self._drain_outbox())
 
     def _cancel_pending(self, reason: str) -> None:
         p, self._pending = self._pending, None
+        self._clear_persisted()
         self.metrics.set_gauge("outbox_pending", 0)
         if p is not None and p.compressed_template is not None:
             # the kept mass never reached the manager: fold it back into
@@ -764,6 +1021,7 @@ class ExperimentWorker:
                 continue  # superseded while the POST was in flight
             if status == 200:
                 self._pending = None
+                self._clear_persisted()
                 self.metrics.set_gauge("outbox_pending", 0)
                 self.n_updates += 1
                 self.metrics.inc("updates_delivered")

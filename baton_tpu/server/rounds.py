@@ -162,8 +162,17 @@ class RoundManager:
 
     def drop_client(self, client_id: str) -> None:
         """Remove a participant mid-round (culled/evicted client) so the
-        round can complete without it."""
+        round can complete without it.
+
+        A client that already delivered an accepted update is NOT
+        dropped: the 200 ack promised the update counts (at-least-once
+        contract), and under streaming aggregation the contribution has
+        already been folded into the running sum — it cannot be
+        retracted. Culling only removes clients the round is still
+        *waiting on*."""
         if not self._in_progress:
+            return
+        if client_id in self.client_responses:
             return
         if client_id in self.clients:
             self._journal(

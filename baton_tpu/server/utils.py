@@ -57,6 +57,38 @@ def json_clean(data: Any) -> Any:
     return data
 
 
+async def bounded_gather(*coros, limit: int, return_exceptions: bool = False):
+    """``asyncio.gather`` behind a concurrency window.
+
+    A 1024-client round must not mean 1024 simultaneous sockets/file
+    descriptors out of the manager (Bonawitz et al. 2019 pace their
+    fan-out the same way): at most ``limit`` of the given coroutines run
+    at once, the rest wait on a semaphore. Results keep input order.
+
+    Failure semantics match ``gather(return_exceptions=True)`` wrapped
+    in a re-raise: one failing coroutine never cancels its siblings —
+    every coroutine runs to completion, and only then is the first
+    exception raised (or, with ``return_exceptions=True``, exceptions
+    are returned in place like plain gather).
+    """
+    if limit <= 0:
+        raise ValueError(f"limit must be positive, got {limit}")
+    sem = asyncio.Semaphore(limit)
+
+    async def windowed(coro):
+        async with sem:
+            return await coro
+
+    results = await asyncio.gather(
+        *(windowed(c) for c in coros), return_exceptions=True
+    )
+    if not return_exceptions:
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
+    return results
+
+
 class RunningMean:
     """Exact (optionally weighted) running mean."""
 

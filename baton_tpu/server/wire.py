@@ -19,7 +19,6 @@ protocol is pickle, SURVEY §2.8).
 
 from __future__ import annotations
 
-import io
 import json
 import pickle
 import struct
@@ -63,9 +62,16 @@ def _np_dtype(name: str):
 
 
 def encode(tensors: Mapping[str, np.ndarray], meta: Dict[str, Any]) -> bytes:
-    """Serialize ``{name: array}`` + JSON-safe metadata to BTW1 bytes."""
+    """Serialize ``{name: array}`` + JSON-safe metadata to BTW1 bytes.
+
+    Exact-size allocation: the header is laid out first, then the
+    output buffer is allocated once at its final size and tensor bytes
+    are written into it through numpy views — no per-tensor ``tobytes``
+    copies, no BytesIO growth doubling, no final concatenation. This
+    matters when the manager encodes a round blob of a large model.
+    """
     header: Dict[str, Any] = {"meta": meta, "tensors": {}}
-    payload = io.BytesIO()
+    arrs = []
     offset = 0
     for name, arr in tensors.items():
         arr = np.ascontiguousarray(arr)
@@ -74,20 +80,36 @@ def encode(tensors: Mapping[str, np.ndarray], meta: Dict[str, Any]) -> bytes:
         )
         if dtype_name not in _ALLOWED_DTYPES:
             raise ValueError(f"unsupported tensor dtype {arr.dtype} for {name!r}")
-        raw = arr.tobytes()
         header["tensors"][name] = {
             "dtype": dtype_name,
             "shape": list(arr.shape),
             "offset": offset,
         }
-        payload.write(raw)
-        offset += len(raw)
+        arrs.append(arr)
+        offset += arr.nbytes
     hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
-    return MAGIC + struct.pack("<I", len(hdr)) + hdr + payload.getvalue()
+    body_start = len(MAGIC) + 4 + len(hdr)
+    out = bytearray(body_start + offset)
+    out[: len(MAGIC)] = MAGIC
+    struct.pack_into("<I", out, len(MAGIC), len(hdr))
+    out[len(MAGIC) + 4 : body_start] = hdr
+    pos = body_start
+    for arr in arrs:
+        if arr.nbytes:
+            dst = np.frombuffer(out, np.uint8, count=arr.nbytes, offset=pos)
+            dst[:] = arr.reshape(-1).view(np.uint8)
+        pos += arr.nbytes
+    return bytes(out)
 
 
 def decode(data: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     """Parse BTW1 bytes → (tensors, meta). No code execution.
+
+    Zero-copy: each returned array is an ``np.frombuffer`` view into
+    ``data``'s buffer, not a copy — decoding a 100 MB payload allocates
+    ~0 additional tensor memory (tests/test_wire.py asserts this). The
+    views keep ``data`` alive; callers that need to outlive the request
+    body don't need to do anything special, the refcount handles it.
 
     Contract for attacker-controlled input: any malformed payload —
     truncated, bit-flipped, wrong lengths — raises ``ValueError`` (or a

@@ -1,0 +1,243 @@
+"""Pull data plane at fan-out scale: downlink bytes/round and broadcast
+latency for C co-located workers on loopback, pull+delta vs the
+push-everything equivalent.
+
+What runs: a manager with ``broadcast_delta`` on and C ``EchoWorker``s
+(no jit training — each "round" perturbs local params slightly so every
+round's blob digest changes, like a real federation). Round 1 every
+worker pulls the full blob; later rounds they pull only the delta blob
+and reconstruct against their anchor, verifying by digest. Recorded per
+cohort size into ``benchmarks/dataplane_scale.json``:
+
+* ``bytes_down_per_round`` (served blob bytes + notify envelopes, from
+  the manager's ``bytes_broadcast`` counter) vs ``push_equiv`` — the
+  C × full_blob bytes the v1 push broadcast would have sent;
+* notify→ack latency p50/p95 across the cohort (the ack covers the
+  whole pull: envelope parse, blob/delta fetch, digest verify, load);
+* manager aggregation memory: tracemalloc peak during the upload wave —
+  streaming FedAvg folds each upload on arrival, so this stays
+  O(model), flat in C (the buffered path grew O(C · model)).
+
+Caveat in the artifact: C workers share this one process/event loop, so
+latency percentiles measure protocol + loopback scheduling, not a real
+network. The byte counts are exact either way.
+
+Run anywhere (no TPU needed):
+    python benchmarks/dataplane_scale.py [--cohorts 16,64,128] [--dim 65536]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import socket
+import sys
+import time
+import tracemalloc
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from baton_tpu.utils.profiling import configure_jax_for_bench  # noqa: E402
+
+# MUST run before any backend touch (see secure_round_scale.py)
+configure_jax_for_bench()
+
+import numpy as np  # noqa: E402
+from aiohttp import web  # noqa: E402
+
+from baton_tpu.models.linear import linear_regression_model  # noqa: E402
+from baton_tpu.server import wire  # noqa: E402
+from baton_tpu.server.http_manager import Manager  # noqa: E402
+from baton_tpu.server.http_worker import ExperimentWorker  # noqa: E402
+from baton_tpu.server.state import (  # noqa: E402
+    params_to_state_dict,
+    state_dict_to_params,
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class EchoWorker(ExperimentWorker):
+    """No jit training: a round nudges local params with seeded noise
+    (every round's aggregate — and therefore blob digest — changes,
+    exercising the delta path) and reports immediately. Also stamps the
+    notify→ack instant so the harness can compute broadcast latency."""
+
+    def __init__(self, *args, ack_log=None, noise_seed=0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._ack_log = ack_log if ack_log is not None else []
+        self._noise_rng = np.random.default_rng(noise_seed)
+
+    async def handle_round_start(self, request):
+        resp = await super().handle_round_start(request)
+        if resp.status == 200:
+            self._ack_log.append(time.perf_counter())
+        return resp
+
+    async def _run_round(self, round_name, n_epoch):
+        try:
+            sd = params_to_state_dict(self.params)
+            noisy = {
+                k: np.asarray(v, np.float32)
+                + np.float32(0.001)
+                * self._noise_rng.standard_normal(np.shape(v)).astype(
+                    np.float32)
+                for k, v in sd.items()
+            }
+            self.params = state_dict_to_params(self.params, noisy)
+            await self.report_update(round_name, 32, [0.0])
+        finally:
+            self.round_in_progress = False
+
+
+async def _one_cohort(c: int, dim: int, rounds: int, delta_spec) -> dict:
+    model = linear_regression_model(dim, name="dpbench")
+    mport = _free_port()
+    mapp = web.Application()
+    exp = Manager(mapp).register_experiment(
+        model, name="dpbench", round_timeout=600.0,
+        broadcast_delta=delta_spec,
+    )
+    mrunner = web.AppRunner(mapp)
+    await mrunner.setup()
+    await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+    runners, workers, ack_log = [mrunner], [], []
+    for i in range(c):
+        wport = _free_port()
+        wapp = web.Application()
+        w = EchoWorker(
+            wapp, model, f"127.0.0.1:{mport}", name="dpbench", port=wport,
+            heartbeat_time=120.0, ack_log=ack_log, noise_seed=i,
+            get_data=lambda: ({}, 32),
+        )
+        wrunner = web.AppRunner(wapp)
+        await wrunner.setup()
+        await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+        workers.append(w)
+        runners.append(wrunner)
+    for _ in range(600):
+        if len(exp.registry) == c:
+            break
+        await asyncio.sleep(0.05)
+    assert len(exp.registry) == c, f"registered {len(exp.registry)}/{c}"
+
+    full_size = len(wire.encode(
+        {k: np.ascontiguousarray(np.asarray(v))
+         for k, v in params_to_state_dict(exp.params).items()}, {}))
+
+    import aiohttp
+
+    per_round = []
+    timeout = aiohttp.ClientTimeout(total=600.0)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        for r in range(rounds):
+            before = exp.metrics.snapshot()["counters"]
+            ack_log.clear()
+            tracemalloc.start()
+            t0 = time.perf_counter()
+            async with session.get(
+                f"http://127.0.0.1:{mport}/dpbench/start_round?n_epoch=1"
+            ) as resp:
+                assert resp.status == 200
+            for _ in range(12000):
+                if not exp.rounds.in_progress:
+                    break
+                await asyncio.sleep(0.05)
+            assert not exp.rounds.in_progress, f"round {r} hung"
+            _, agg_peak = tracemalloc.get_traced_memory()
+            tracemalloc.stop()
+            after = exp.metrics.snapshot()["counters"]
+            lat = sorted(t - t0 for t in ack_log)
+
+            def pct(xs, q):
+                return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else None
+
+            per_round.append({
+                "round": r,
+                "bytes_down": after.get("bytes_broadcast", 0.0)
+                - before.get("bytes_broadcast", 0.0),
+                "bytes_up": after.get("bytes_uploaded", 0.0)
+                - before.get("bytes_uploaded", 0.0),
+                "blob_hits_full": after.get("blob_hits_full", 0.0)
+                - before.get("blob_hits_full", 0.0),
+                "blob_hits_delta": after.get("blob_hits_delta", 0.0)
+                - before.get("blob_hits_delta", 0.0),
+                "range_resumes": after.get("range_resumes", 0.0)
+                - before.get("range_resumes", 0.0),
+                "acks": len(lat),
+                "notify_ack_p50_s": pct(lat, 0.50),
+                "notify_ack_p95_s": pct(lat, 0.95),
+                "round_wall_s": time.perf_counter() - t0,
+                "manager_round_python_peak_bytes": agg_peak,
+            })
+            print(f"[C={c}] round {r}: down={per_round[-1]['bytes_down']:.0f}B"
+                  f" (push_equiv={c * full_size}B)"
+                  f" delta_hits={per_round[-1]['blob_hits_delta']:.0f}"
+                  f" p95={per_round[-1]['notify_ack_p95_s']:.3f}s",
+                  file=sys.stderr, flush=True)
+
+    for r in runners:
+        await r.cleanup()
+
+    # steady state excludes round 0 (every worker's first pull is full)
+    steady = per_round[1:] or per_round
+    mean_down = sum(p["bytes_down"] for p in steady) / len(steady)
+    push_equiv = float(c * full_size)
+    return {
+        "cohort": c,
+        "model_dim": dim,
+        "full_blob_bytes": full_size,
+        "push_equiv_bytes_per_round": push_equiv,
+        "steady_bytes_down_per_round": mean_down,
+        "downlink_reduction_x": push_equiv / max(mean_down, 1.0),
+        "rounds": per_round,
+    }
+
+
+async def _main(cohorts, dim, rounds, spec) -> dict:
+    out = {
+        "benchmark": "dataplane_scale",
+        "delta_spec": spec,
+        "caveat": (
+            "all C workers share one process and event loop; latency "
+            "percentiles measure protocol + loopback scheduling, not a "
+            "real network. Byte counts are exact."
+        ),
+        "results": [],
+    }
+    for c in cohorts:
+        out["results"].append(await _one_cohort(c, dim, rounds, spec))
+    return out
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cohorts", default="16,64,128")
+    ap.add_argument("--dim", type=int, default=65536)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--delta-spec", default="topk:0.05:q8")
+    ap.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(__file__),
+                             "dataplane_scale.json"),
+    )
+    args = ap.parse_args()
+    cohorts = [int(x) for x in args.cohorts.split(",") if x]
+    result = asyncio.run(_main(cohorts, args.dim, args.rounds,
+                               args.delta_spec))
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    for r in result["results"]:
+        print(f"C={r['cohort']}: {r['downlink_reduction_x']:.1f}x downlink "
+              f"reduction ({r['steady_bytes_down_per_round']:.0f}B vs "
+              f"push {r['push_equiv_bytes_per_round']:.0f}B per round)")
+    print(f"wrote {args.out}")

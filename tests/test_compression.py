@@ -182,7 +182,10 @@ def test_compressed_federation_over_http():
         mapp = web.Application()
         manager = Manager(mapp)
         exp = manager.register_experiment(
-            model, name="comptest", round_timeout=60.0
+            model, name="comptest", round_timeout=60.0,
+            # buffered path: the exactness assertion below inspects the
+            # per-client decoded state_dicts, which streaming frees
+            streaming_aggregation=False,
         )
         mrunner = web.AppRunner(mapp)
         await mrunner.setup()
@@ -478,7 +481,10 @@ def test_quantized_broadcast_federation_converges():
         mapp = web.Application()
         manager = Manager(mapp)
         exp = manager.register_experiment(
-            model, name="dq", round_timeout=60.0, broadcast_quantize_bits=16
+            model, name="dq", round_timeout=60.0, broadcast_quantize_bits=16,
+            # buffered path: the exactness assertion below inspects the
+            # per-client decoded state_dicts, which streaming frees
+            streaming_aggregation=False,
         )
         mrunner = web.AppRunner(mapp)
         await mrunner.setup()

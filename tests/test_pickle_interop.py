@@ -24,6 +24,7 @@ from aiohttp.test_utils import TestClient, TestServer
 from baton_tpu.models.linear import linear_regression_model
 from baton_tpu.server.http_manager import Manager
 from baton_tpu.server import wire
+from baton_tpu.server.state import params_to_state_dict
 
 
 def free_port() -> int:
@@ -168,8 +169,13 @@ def test_reference_protocol_worker_completes_round():
 
 
 def test_btw1_worker_unaffected_by_default():
-    """Default experiments still broadcast BTW1 (no silent pickle)."""
+    """Default experiments never silently pickle: the notify is a JSON
+    envelope naming a content-addressed blob, and the blob itself is
+    BTW1 (v2 pull data plane)."""
     async def main():
+        import hashlib
+        import json
+
         model = linear_regression_model(2)
         mapp = web.Application()
         manager = Manager(mapp)
@@ -198,11 +204,33 @@ def test_btw1_worker_unaffected_by_default():
                 f"{manager_url}/safe/register",
                 json={"port": port, "url": f"http://127.0.0.1:{port}/safe"},
             ) as resp:
-                await resp.json()
+                creds = await resp.json()
             resp = await mclient.get("/safe/start_round?n_epoch=1")
             assert resp.status == 200
 
-        assert seen and seen[0][:4] == wire.MAGIC
+        # the notify is a small JSON envelope, never a pickle
+        assert seen
+        env = json.loads(seen[0].decode())
+        assert env["v"] == 2
+        assert env["update_name"].startswith("update_safe_")
+        digest = env["blob"]["digest"]
+
+        # and the blob it names is BTW1, served content-addressed
+        resp = await mclient.get(
+            f"/safe/round_blob/{digest}"
+            f"?client_id={creds['client_id']}&key={creds['key']}"
+        )
+        assert resp.status == 200
+        blob = await resp.read()
+        assert blob[:4] == wire.MAGIC
+        assert hashlib.sha256(blob).hexdigest() == digest
+        assert len(blob) == env["blob"]["size"]
+        tensors, meta = wire.decode(blob)
+        assert set(tensors) == set(
+            params_to_state_dict(
+                manager.experiments[0].params
+            )
+        )
         await runner.cleanup()
         await mclient.close()
 

@@ -207,8 +207,10 @@ def test_duplicate_update_id_acked_but_not_recounted():
         # membership stats counted the upload once
         assert exp.registry[creds[0]["client_id"]].num_updates == 1
 
-        # a NEW update from the same client (fresh update_id) replaces
-        # the previous one instead of being deduped
+        # a NEW update from the same client (fresh update_id) is acked —
+        # at-least-once delivery — but the FIRST accepted upload remains
+        # final: under streaming aggregation the original contribution
+        # is already folded into the running sum and cannot be retracted
         body2 = wire.encode(
             params_to_state_dict(exp.params),
             {"update_name": exp.rounds.round_name, "n_samples": 8,
@@ -220,7 +222,10 @@ def test_duplicate_update_id_acked_but_not_recounted():
         )
         assert resp.status == 200
         assert len(exp.rounds.client_responses) == 1
-        assert exp.rounds.update_ids[creds[0]["client_id"]] == "uid-2"
+        assert exp.rounds.update_ids[creds[0]["client_id"]] == "uid-1"
+        snap = exp.metrics.snapshot()
+        assert snap["counters"]["repeat_updates_ignored"] == 1
+        assert snap["counters"]["updates_received"] == 1
         await client.close()
 
     run(main())

@@ -211,3 +211,50 @@ def test_decode_rejects_bool_and_huge_dims():
     for c in cases:
         with pytest.raises(ValueError):
             wire.decode(c)
+
+
+def test_decode_is_zero_copy_views():
+    """Decoded arrays are frombuffer VIEWS into the payload, not copies
+    — the property the blob data plane leans on (a worker decoding a
+    large round blob must not double its memory)."""
+    sd = {
+        "w": np.arange(64, dtype=np.float32).reshape(8, 8),
+        "b": np.ones(8, dtype=np.float64),
+    }
+    data = wire.encode(sd, {})
+    tensors, _ = wire.decode(data)
+    for name, arr in tensors.items():
+        assert not arr.flags.owndata, name  # a view, not an allocation
+        base = arr
+        while isinstance(base, np.ndarray) and base.base is not None:
+            base = base.base
+        # the ultimate base is the payload's buffer (a memoryview over
+        # the request body bytes), not a fresh allocation
+        assert isinstance(base, memoryview) and base.obj is data, name
+        np.testing.assert_array_equal(arr, sd[name])
+
+
+def test_decode_100mb_does_not_double_peak_memory():
+    """Decoding a ~100 MB payload must allocate ~no tensor memory:
+    tracemalloc (which tracks numpy's allocator) sees only header-sized
+    allocations during decode."""
+    import tracemalloc
+
+    n = 25_000_000  # 100 MB of float32
+    payload = wire.encode(
+        {"big": np.zeros(n, dtype=np.float32)}, {"round": 1}
+    )
+    assert len(payload) > 100_000_000
+
+    tracemalloc.start()
+    before, _ = tracemalloc.get_traced_memory()
+    tensors, meta = wire.decode(payload)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+
+    # decode's peak over the baseline stays far below the payload size
+    # (a copying decode would show +100 MB here)
+    assert peak - before < 10_000_000, f"decode peaked {peak - before} bytes"
+    assert tensors["big"].nbytes == 100_000_000
+    assert meta == {"round": 1}
+    assert not tensors["big"].flags.owndata
