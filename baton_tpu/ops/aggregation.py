@@ -21,6 +21,7 @@ The unit-test oracle is the reference formula evaluated in numpy
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Optional, Sequence
 
 import jax
@@ -238,28 +239,35 @@ class StreamingMean:
     Only valid for the ``"mean"`` aggregator: trimmed mean / coordinate
     median are order statistics over the full cohort and keep the
     buffered path (selected by spec in the HTTP manager).
+
+    Thread-safety: ``add``/``mean`` take an internal lock. The ingest
+    pipeline folds on an executor thread while the simulator path folds
+    on the event loop, and numpy releases the GIL mid-ufunc — without
+    the lock a concurrent first-fold could drop an update.
     """
 
     def __init__(self) -> None:
         self._sums: Optional[dict] = None
         self._weight = np.float32(0.0)
         self.count = 0
+        self._lock = threading.Lock()
 
     def add(self, state_dict: dict, weight: float) -> None:
         """Fold one client's ``{name: array}`` update with sample weight
         ``weight``. After this returns the caller may drop the tensors."""
         w = np.float32(weight)
-        if self._sums is None:
-            self._sums = {
-                k: np.asarray(v, np.float32) * w
-                for k, v in state_dict.items()
-            }
-        else:
-            for k, v in state_dict.items():
-                # in-place: no per-update O(model) allocation
-                self._sums[k] += np.asarray(v, np.float32) * w
-        self._weight = self._weight + w
-        self.count += 1
+        with self._lock:
+            if self._sums is None:
+                self._sums = {
+                    k: np.asarray(v, np.float32) * w
+                    for k, v in state_dict.items()
+                }
+            else:
+                for k, v in state_dict.items():
+                    # in-place: no per-update O(model) allocation
+                    self._sums[k] += np.asarray(v, np.float32) * w
+            self._weight = self._weight + w
+            self.count += 1
 
     @property
     def total_weight(self) -> float:
@@ -268,10 +276,68 @@ class StreamingMean:
     def mean(self) -> Optional[dict]:
         """``Σ w·x / max(Σ w, 1e-9)`` as fp32 arrays, or None if nothing
         was folded. Matches :func:`weighted_tree_mean`'s clamped denom."""
-        if self._sums is None:
+        with self._lock:
+            if self._sums is None:
+                return None
+            denom = np.maximum(self._weight, np.float32(1e-9))
+            return {k: v / denom for k, v in self._sums.items()}
+
+
+class ShardedStreamingMean:
+    """N independent :class:`StreamingMean` partials — the manager's
+    opt-in ``fold_shards>1`` ingest mode.
+
+    Each shard folds on its own single-thread fold lane, so shards run
+    concurrently while folds *within* a shard stay acceptance-ordered.
+    The partials merge at ``mean()`` time: weighted sums are
+    associative, so the merged result equals the sequential fold up to
+    fp32 reduction order (pinned by the streaming≡buffered tolerance
+    test in ``tests/test_ingest.py``). Same duck type as StreamingMean
+    (``add``/``mean``/``count``/``total_weight``) with an extra
+    ``shard=`` routing argument.
+    """
+
+    def __init__(self, shards: int = 1) -> None:
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        self.partials = [StreamingMean() for _ in range(int(shards))]
+
+    @property
+    def shards(self) -> int:
+        return len(self.partials)
+
+    @property
+    def count(self) -> int:
+        return sum(p.count for p in self.partials)
+
+    @property
+    def total_weight(self) -> float:
+        return float(sum(p.total_weight for p in self.partials))
+
+    def add(self, state_dict: dict, weight: float, shard: int = 0) -> None:
+        self.partials[int(shard) % len(self.partials)].add(state_dict, weight)
+
+    def mean(self) -> Optional[dict]:
+        """Merge partial ``(Σ w·x, Σ w)`` pairs, then divide once."""
+        sums: Optional[dict] = None
+        weight = np.float32(0.0)
+        for p in self.partials:
+            with p._lock:
+                if p._sums is None:
+                    continue
+                if sums is None:
+                    sums = {
+                        k: np.array(v, np.float32, copy=True)
+                        for k, v in p._sums.items()
+                    }
+                else:
+                    for k, v in p._sums.items():
+                        sums[k] += v
+                weight = weight + p._weight
+        if sums is None:
             return None
-        denom = np.maximum(self._weight, np.float32(1e-9))
-        return {k: v / denom for k, v in self._sums.items()}
+        denom = np.maximum(weight, np.float32(1e-9))
+        return {k: v / denom for k, v in sums.items()}
 
 
 def psum_weighted_scalar_mean(

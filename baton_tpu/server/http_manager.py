@@ -9,9 +9,21 @@ and status codes, under ``/{experiment}/``:
   GET  start_round   ?n_epoch= (default 32)   → {client_id: ack} | 400 | 423
   GET  end_round                              → round state JSON
   GET  loss_history                           → JSON list
-  POST update        ?client_id&key, tensors  → "OK" | 401 | 410
+  POST update        ?client_id&key, tensors  → "OK" | 401 | 410 | 413 | 429
   GET  round_blob/{digest}  ?client_id&key    → BTW1 bytes | 401 | 404
                      (v2 pull data plane; supports HTTP Range resume)
+  PUT  update_chunk/{update_id}  ?client_id&key&offset&total
+                     → {"offset"} per chunk, final chunk acks like POST
+                       update | 409 {"offset": committed} | 413 | 429
+  GET  update_chunk/{update_id}  ?client_id&key → {"offset", "total"}
+                     committed-offset resume probe (HEAD works too)
+
+Uplink ingest (v2): bodies are size-capped at the door
+(``max_upload_bytes`` → 413), admitted through a bounded decode queue
+(full → 429 + Retry-After), then decoded/validated/folded OFF the event
+loop by the ingest pipeline (server/ingest.py) — the loop only does
+auth, round checks, and acceptance bookkeeping, so heartbeats and blob
+GETs stay responsive while 64 workers upload at once.
 
 Data plane (v2, default): ``start_round`` serializes the round's params
 ONCE into an immutable content-addressed blob (server/blobs.py); each
@@ -78,15 +90,33 @@ from baton_tpu.core.model import FedModel
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.server import wire
 from baton_tpu.server.blobs import BlobStore
+from baton_tpu.server.ingest import ChunkSession, IngestPipeline
 from baton_tpu.server.registry import AuthError, ClientRegistry, UnknownClient
 from baton_tpu.server.rounds import RoundInProgress, RoundManager
 from baton_tpu.server.state import params_to_state_dict, state_dict_to_params
-from baton_tpu.server.utils import PeriodicTask, bounded_gather, json_clean
+from baton_tpu.server.utils import (
+    BodyTooLarge,
+    PeriodicTask,
+    bounded_gather,
+    json_clean,
+    read_body_capped,
+)
 from baton_tpu.utils.metrics import Metrics
 
 DEFAULT_N_EPOCH = 32  # reference manager.py:52-55
 
 _log = logging.getLogger(__name__)
+
+
+class _BadUpload(ValueError):
+    """An upload rejected with a *specific* 400 message (unknown
+    compression scheme, compressed-in-secure-round, ...). Raised from
+    the off-loop decode stage so the handler can distinguish precise
+    rejections from the generic "Bad Payload" catch-all."""
+
+    def __init__(self, msg: str) -> None:
+        super().__init__(msg)
+        self.msg = msg
 
 
 class Manager:
@@ -140,6 +170,11 @@ class Experiment:
         journal_path: Optional[str] = None,
         journal_fsync: Any = "always",
         recovery_policy: str = "resume",
+        max_upload_bytes: Optional[int] = 1 << 30,
+        ingest_workers: int = 4,
+        ingest_queue_depth: int = 64,
+        fold_shards: int = 1,
+        max_chunk_sessions: int = 64,
     ):
         """``aggregator``: ``"mean"`` (sample-weighted FedAvg, reference
         manager.py:119-126), or Byzantine-robust ``"trimmed:<ratio>"`` /
@@ -195,7 +230,33 @@ class Experiment:
         Secure-aggregation rounds always abort on recovery: the mask/
         share state lived only in the dead process. ``journal_fsync``
         is the :class:`~baton_tpu.server.journal.Journal` policy
-        (``"always"`` | ``"never"`` | seconds between fsyncs)."""
+        (``"always"`` | ``"never"`` | seconds between fsyncs).
+
+        ``max_upload_bytes``: admission cap on any single uplink body
+        (update POST, chunk PUT, or a chunked upload's declared total).
+        Oversized requests get ``413`` at the door — Content-Length is
+        checked before the body is read, and streamed reads are cut off
+        at the cap. ``None`` disables the cap.
+
+        ``ingest_workers`` / ``ingest_queue_depth``: the uplink ingest
+        pipeline (server/ingest.py). Body decode, payload validation,
+        top-k decompression, and the streaming fold run on a pool of
+        ``ingest_workers`` threads so the event loop only does auth/
+        round checks and hand-off; at most ``ingest_queue_depth``
+        uploads may be in the decode stage at once, beyond which the
+        manager answers ``429`` with ``Retry-After`` (the worker
+        outbox's backoff honors it). ``ingest_workers=0`` disables the
+        pipeline and restores the legacy fully-on-loop path.
+
+        ``fold_shards``: number of parallel fold lanes for the
+        streaming accumulator. The default 1 folds in acceptance order
+        (bit-deterministic, same as the on-loop fold); ``>1`` opts into
+        N partial accumulators merged at ``end_round`` — equal to the
+        sequential fold up to fp32 reduction order.
+
+        ``max_chunk_sessions``: cap on concurrently assembling chunked
+        uploads (each can hold up to ``max_upload_bytes``); beyond it
+        new sessions get ``429``."""
         if secure_agg and allow_pickle:
             raise ValueError(
                 "secure_agg is incompatible with allow_pickle: reference-"
@@ -236,10 +297,33 @@ class Experiment:
         self._blobs = BlobStore()
         self._prev_blob_sd: Optional[dict] = None
         self._prev_blob_digest: Optional[str] = None
+        # last round's delta blob descriptor {digest, size, from, to} —
+        # retained one extra round so a worker anchored two rounds back
+        # can chain anchor → N-1 → N instead of paying a full pull
+        self._prev_delta_hop: Optional[dict] = None
         # streaming FedAvg accumulator for the round in flight (None for
         # robust/secure rounds, which need the buffered path)
         self._stream_acc = None
         self.streaming_aggregation = bool(streaming_aggregation)
+        if max_upload_bytes is not None and max_upload_bytes < 1:
+            raise ValueError(
+                f"max_upload_bytes must be >= 1 or None, got {max_upload_bytes}"
+            )
+        if ingest_workers < 0:
+            raise ValueError(
+                f"ingest_workers must be >= 0, got {ingest_workers}"
+            )
+        if fold_shards < 1:
+            raise ValueError(f"fold_shards must be >= 1, got {fold_shards}")
+        if max_chunk_sessions < 1:
+            raise ValueError(
+                f"max_chunk_sessions must be >= 1, got {max_chunk_sessions}"
+            )
+        self.max_upload_bytes = (
+            None if max_upload_bytes is None else int(max_upload_bytes)
+        )
+        self.fold_shards = int(fold_shards)
+        self.max_chunk_sessions = int(max_chunk_sessions)
         if not (0.0 < cohort_fraction <= 1.0):
             raise ValueError(
                 f"cohort_fraction must be in (0, 1], got {cohort_fraction}"
@@ -278,6 +362,25 @@ class Experiment:
             name, round_timeout=round_timeout, journal=self.journal
         )
         self.metrics = metrics or Metrics()
+        # uplink ingest pipeline (None = legacy fully-on-loop path)
+        self._ingest = (
+            IngestPipeline(
+                workers=ingest_workers,
+                queue_depth=ingest_queue_depth,
+                fold_shards=fold_shards,
+                metrics=self.metrics,
+            )
+            if ingest_workers > 0
+            else None
+        )
+        # chunked resumable uploads: (client_id, update_id) → ChunkSession
+        self._chunks: Dict[tuple, ChunkSession] = {}
+        # round-robin shard cursor for fold_shards>1 (reset per round)
+        self._fold_rr = 0
+        # client_ids mid-acceptance across an off-loop decompress await
+        # (buffered compressed path) — treated like client_responses for
+        # duplicate suppression
+        self._accepting: set = set()
         self.checkpointer = None
         if checkpoint_dir is not None:
             from baton_tpu.utils.checkpoint import Checkpointer
@@ -414,7 +517,7 @@ class Experiment:
         }
         self._broadcast_anchor_sd = state_dict
         self._stream_acc = (
-            agg.StreamingMean()
+            self._new_stream_acc()
             if self.streaming_aggregation and self.aggregator[0] == "mean"
             else None
         )
@@ -470,6 +573,8 @@ class Experiment:
         if self._checkpoint_task is not None:
             await self._checkpoint_task
             self._checkpoint_task = None
+        if self._ingest is not None:
+            self._ingest.shutdown()
         if self.checkpointer is not None:
             self.checkpointer.close()
         if self.journal is not None:
@@ -509,6 +614,16 @@ class Experiment:
         r.add_get(f"/{self.name}/end_round", self.handle_end_round)
         r.add_get(f"/{self.name}/loss_history", self.handle_loss_history)
         r.add_post(f"/{self.name}/update", self.handle_update)
+        # chunked resumable uplink: offset/total-framed PUTs + a GET
+        # offset probe (aiohttp auto-answers HEAD for GET routes)
+        r.add_put(
+            f"/{self.name}/update_chunk/{{update_id}}",
+            self.handle_update_chunk,
+        )
+        r.add_get(
+            f"/{self.name}/update_chunk/{{update_id}}",
+            self.handle_update_chunk_probe,
+        )
         r.add_get(f"/{self.name}/metrics", self.handle_metrics)
         r.add_get(
             f"/{self.name}/round_blob/{{digest}}", self.handle_round_blob
@@ -618,6 +733,23 @@ class Experiment:
         snap["gauges"]["round_in_progress"] = float(self.rounds.in_progress)
         return web.json_response(snap)
 
+    def _new_stream_acc(self):
+        """The round's streaming accumulator: sequential (deterministic)
+        by default, sharded partials under ``fold_shards>1``."""
+        if self.fold_shards > 1:
+            return agg.ShardedStreamingMean(self.fold_shards)
+        return agg.StreamingMean()
+
+    def _retry_after_s(self) -> float:
+        return self._ingest.retry_after_s if self._ingest is not None else 1.0
+
+    def _reject_429(self, msg: str) -> web.Response:
+        self.metrics.inc("ingest_rejected_429")
+        return web.json_response(
+            {"err": msg}, status=429,
+            headers={"Retry-After": f"{self._retry_after_s():g}"},
+        )
+
     async def handle_update(self, request: web.Request) -> web.Response:
         try:
             client_id = self.registry.verify(
@@ -625,11 +757,25 @@ class Experiment:
             )
         except (UnknownClient, AuthError):
             return web.json_response({"err": "Unauthorized"}, status=401)
-        body = await request.read()
-        self.metrics.inc("bytes_uploaded", len(body))
         try:
+            body = await read_body_capped(request, self.max_upload_bytes)
+        except BodyTooLarge:
+            self.metrics.inc("uploads_rejected_413")
+            return web.json_response({"err": "Payload Too Large"}, status=413)
+        self.metrics.inc("bytes_uploaded", len(body))
+        return await self._ingest_update(client_id, body, request.content_type)
+
+    def _make_upload_decoder(self, body: bytes, content_type):
+        """Build the decode+validate closure the ingest pipeline runs on
+        a pool thread. Pure CPU work over immutable inputs — no loop
+        state is touched off-loop (the anchor hint is captured here, on
+        the loop; validation only needs the model's shapes, which are
+        round-independent)."""
+        anchor_hint = self._broadcast_anchor_sd
+
+        def decode():
             tensors, meta = wire.decode_any(
-                body, request.content_type, allow_pickle=self.allow_pickle
+                body, content_type, allow_pickle=self.allow_pickle
             )
             # validate at the door: a missing/mis-shaped tensor must be
             # rejected now, not crash aggregation after the round state
@@ -638,40 +784,70 @@ class Experiment:
             # loss_history 400s at the door instead of 500ing later
             meta_n_samples = float(meta.get("n_samples", 0))
             meta_losses = [float(x) for x in meta.get("loss_history", [])]
-            update_id = str(meta["update_id"]) if meta.get("update_id") else None
-            compressed_anchor = None
+            update_id = (
+                str(meta["update_id"]) if meta.get("update_id") else None
+            )
+            compressed = False
             if meta.get("compressed"):
                 if self.secure_agg:
                     # a sparse support set leaks which coordinates moved;
                     # masking needs dense ring elements (ops/compression.py)
-                    return web.json_response(
-                        {"err": "Compressed Upload In Secure Round"},
-                        status=400,
-                    )
+                    raise _BadUpload("Compressed Upload In Secure Round")
                 scheme = (meta["compressed"] or {}).get("scheme") \
                     if isinstance(meta["compressed"], dict) else None
                 if scheme != "topk":
                     # an unknown scheme decoded under top-k semantics
                     # would poison the aggregate; reject precisely
-                    return web.json_response(
-                        {"err": f"Unknown Compression Scheme {scheme!r}"},
-                        status=400,
-                    )
-                # the per-round anchor (set once in start_round; what
-                # clients loaded). Fallback covers uploads arriving for
-                # a round started before a manager code reload.
-                compressed_anchor = (
-                    self._broadcast_anchor_sd
-                    if self._broadcast_anchor_sd is not None
+                    raise _BadUpload(f"Unknown Compression Scheme {scheme!r}")
+                compressed = True
+                anchor = (
+                    anchor_hint
+                    if anchor_hint is not None
                     else params_to_state_dict(self.params)
                 )
-                self._validate_compressed_upload(tensors, compressed_anchor)
+                self._validate_compressed_upload(tensors, anchor)
             elif self.secure_agg:
                 self._validate_masked_upload(tensors, meta)
             else:
                 state_dict_to_params(self.params, tensors)
+            return tensors, meta, meta_n_samples, meta_losses, update_id, \
+                compressed
+
+        return decode
+
+    async def _ingest_update(
+        self, client_id: str, body: bytes, content_type
+    ) -> web.Response:
+        """Accept one assembled upload body (single POST or completed
+        chunk session): decode/validate off-loop, then run the round
+        checks + acceptance bookkeeping loop-atomically, then fold.
+
+        The acceptance-point invariant from PR 2 holds: once the 200 is
+        sent, the update counts — so all bookkeeping happens with no
+        intervening await, and the off-loop fold this handler awaits
+        before answering is guaranteed to land in the round mean
+        (``end_round`` additionally drains the fold lanes)."""
+        decode = self._make_upload_decoder(body, content_type)
+        pipe = self._ingest
+        try:
+            if pipe is not None:
+                fut = pipe.submit_decode(decode)
+                if fut is None:
+                    return self._reject_429("Ingest Queue Full")
+                decoded = await fut
+            else:
+                decoded = decode()
+        except _BadUpload as e:
+            return web.json_response({"err": e.msg}, status=400)
+        except (MemoryError, asyncio.CancelledError):
+            # resource exhaustion / shutdown are NOT client errors: let
+            # them propagate (500 / cancellation) instead of masking
+            # them as "Bad Payload" and silently inviting a retry
+            raise
         except Exception:
             return web.json_response({"err": "Bad Payload"}, status=400)
+        tensors, meta, meta_n_samples, meta_losses, update_id, compressed = \
+            decoded
         round_name = meta.get("update_name")
         if not self.rounds.in_progress or round_name != self.rounds.round_name:
             return web.json_response({"error": "Wrong Update"}, status=410)
@@ -710,49 +886,219 @@ class Experiment:
             # would double this client's sample weight in the aggregate.
             self.metrics.inc("duplicate_updates_deduped")
             return web.json_response("OK")
-        if client_id in self.rounds.client_responses:
+        if (
+            client_id in self.rounds.client_responses
+            or client_id in self._accepting
+        ):
             # a DIFFERENT update from a client whose first update was
-            # already accepted: the first accepted update per client per
-            # round is FINAL — its 200 ack promised it counts, and under
-            # streaming aggregation it is already folded into the running
-            # sum and cannot be retracted. Ack without recounting.
+            # already accepted (or is mid-acceptance across the buffered
+            # path's decompress await): the first accepted update per
+            # client per round is FINAL — its 200 ack promised it
+            # counts, and under streaming aggregation it is already
+            # folded into the running sum and cannot be retracted.
             self.metrics.inc("repeat_updates_ignored")
             return web.json_response("OK")
-        if compressed_anchor is not None:
-            # reconstruct AFTER the round checks: the anchor (this
-            # round's broadcast == self.params, unchanged until
-            # end_round) is only right for the current round; stale
-            # uploads were already 410'd above
-            tensors = self._decompress_upload(tensors, compressed_anchor)
-            self.metrics.inc("compressed_updates_received")
+        # the per-round anchor (set once in start_round; what clients
+        # loaded). Read AFTER the 410s: stale uploads never reach it.
+        anchor = (
+            self._broadcast_anchor_sd
+            if self._broadcast_anchor_sd is not None
+            else params_to_state_dict(self.params)
+        )
         response = {
             "masked": bool(meta.get("secure", False)),
             "n_samples": meta_n_samples,
             "loss_history": meta_losses,
             "update_id": update_id,
         }
-        if self._stream_acc is not None and not response["masked"]:
-            # streaming FedAvg: fold NOW and free the tensors — manager
-            # memory stays O(model) no matter the cohort size. Restrict
-            # to the round anchor's keys so a surplus tensor in an
-            # upload cannot enter the running sums.
-            anchor = (
-                self._broadcast_anchor_sd
-                if self._broadcast_anchor_sd is not None
-                else params_to_state_dict(self.params)
-            )
-            self._stream_acc.add(
-                {k: tensors[k] for k in anchor}, meta_n_samples
-            )
+        acc = self._stream_acc
+        if acc is not None and not response["masked"]:
+            # streaming FedAvg: acceptance bookkeeping FIRST (no await
+            # between the checks above and client_end — loop-atomic, so
+            # a racing duplicate sees client_responses), then the
+            # decompress+fold runs off-loop on this shard's fold lane.
+            # Awaiting it before the 200 keeps the old contract: after
+            # any ack, the update IS in the running sum. Restrict to the
+            # anchor's keys so a surplus tensor in an upload cannot
+            # enter the running sums.
             response["streamed"] = True
-        else:
-            response["state_dict"] = tensors
+            self.rounds.client_end(client_id, response)
+            self.registry.record_update(client_id, round_name)
+            self.metrics.inc("updates_received")
+            if compressed:
+                self.metrics.inc("compressed_updates_received")
+            shard = 0
+            if self.fold_shards > 1:
+                shard = self._fold_rr % self.fold_shards
+                self._fold_rr += 1
+            sharded = self.fold_shards > 1
+
+            def fold():
+                t = tensors
+                if compressed:
+                    t = self._decompress_upload(t, anchor)
+                payload = {k: t[k] for k in anchor}
+                if sharded:
+                    acc.add(payload, meta_n_samples, shard=shard)
+                else:
+                    acc.add(payload, meta_n_samples)
+
+            if pipe is not None:
+                await pipe.submit_fold(shard, fold)
+            else:
+                fold()
+            del tensors
+            self._maybe_finish()
+            return web.json_response("OK")
+        # buffered / masked path: tensors are retained until end_round
+        if compressed:
+            # reconstruct AFTER the round checks: the anchor is only
+            # right for the current round; stale uploads were already
+            # 410'd above. The decompress runs off-loop, so the client
+            # is flagged mid-acceptance for duplicate suppression and
+            # the round checks re-run after the await.
+            self._accepting.add(client_id)
+            try:
+                if pipe is not None:
+                    tensors = await pipe.run_unbounded(
+                        lambda: self._decompress_upload(tensors, anchor)
+                    )
+                else:
+                    tensors = self._decompress_upload(tensors, anchor)
+            finally:
+                self._accepting.discard(client_id)
+            if (
+                not self.rounds.in_progress
+                or round_name != self.rounds.round_name
+            ):
+                return web.json_response({"error": "Wrong Update"}, status=410)
+            if client_id not in self.rounds.clients:
+                return web.json_response(
+                    {"error": "Not A Participant"}, status=410
+                )
+            self.metrics.inc("compressed_updates_received")
+        response["state_dict"] = tensors
         del tensors
         self.rounds.client_end(client_id, response)
         self.registry.record_update(client_id, round_name)
         self.metrics.inc("updates_received")
         self._maybe_finish()
         return web.json_response("OK")
+
+    # -- chunked resumable uplink --------------------------------------
+    async def handle_update_chunk(self, request: web.Request) -> web.Response:
+        """``PUT /{name}/update_chunk/{update_id}?offset=&total=``.
+
+        Chunks append strictly at the committed offset; a mismatched
+        ``offset`` answers ``409 {"offset": committed}`` and the worker
+        resumes from there (the manager is authoritative). The final
+        chunk's response IS the update's acceptance response — 200 means
+        accepted exactly as a single POST would have been."""
+        try:
+            client_id = self.registry.verify(
+                request.query.get("client_id", ""), request.query.get("key", "")
+            )
+        except (UnknownClient, AuthError):
+            return web.json_response({"err": "Unauthorized"}, status=401)
+        update_id = request.match_info["update_id"]
+        try:
+            offset = int(request.query["offset"])
+            total = int(request.query["total"])
+        except (KeyError, ValueError):
+            return web.json_response({"err": "Bad Chunk Framing"}, status=400)
+        if total <= 0 or offset < 0 or offset > total:
+            return web.json_response({"err": "Bad Chunk Framing"}, status=400)
+        if self.max_upload_bytes is not None and total > self.max_upload_bytes:
+            # declared-size admission: reject the whole upload on its
+            # FIRST chunk, before buffering anything
+            self.metrics.inc("uploads_rejected_413")
+            return web.json_response({"err": "Payload Too Large"}, status=413)
+        key = (client_id, update_id)
+        sess = self._chunks.get(key)
+        if sess is None:
+            if offset != 0:
+                # unknown session (evicted, or a probe raced a round
+                # roll): the committed offset is 0 — start over
+                return web.json_response(
+                    {"err": "Unknown Chunk Session", "offset": 0}, status=409
+                )
+            if len(self._chunks) >= self.max_chunk_sessions:
+                return self._reject_429("Too Many Chunk Sessions")
+            sess = ChunkSession(
+                client_id=client_id, update_id=update_id, total=total
+            )
+            self._chunks[key] = sess
+            self.metrics.set_gauge("chunk_sessions_active", len(self._chunks))
+        if sess.total != total:
+            # inconsistent framing poisons the session — drop it
+            self._chunks.pop(key, None)
+            self.metrics.set_gauge("chunk_sessions_active", len(self._chunks))
+            return web.json_response({"err": "Inconsistent Total"}, status=400)
+        if sess.busy:
+            # a zombie retry racing its own live transfer must not
+            # interleave bytes into the buffer
+            return web.json_response(
+                {"err": "Chunk In Flight", "offset": sess.offset}, status=409
+            )
+        if offset != sess.offset:
+            return web.json_response(
+                {"err": "Offset Mismatch", "offset": sess.offset}, status=409
+            )
+        sess.busy = True
+        try:
+            try:
+                chunk = await read_body_capped(request, sess.total - offset)
+            except BodyTooLarge:
+                self.metrics.inc("uploads_rejected_413")
+                return web.json_response(
+                    {"err": "Chunk Overruns Total"}, status=413
+                )
+            if offset == 0 and len(chunk) >= 4 and not self.allow_pickle \
+                    and not wire.is_btw1(chunk):
+                # first-frame sniff: don't buffer max_upload_bytes of a
+                # payload that is destined for "Bad Payload" anyway
+                self._chunks.pop(key, None)
+                self.metrics.set_gauge(
+                    "chunk_sessions_active", len(self._chunks))
+                return web.json_response({"err": "Bad Payload"}, status=400)
+            sess.buf.extend(chunk)
+            self.metrics.inc("bytes_uploaded", len(chunk))
+            self.metrics.inc("chunk_bytes_received", len(chunk))
+            if sess.offset < sess.total:
+                return web.json_response({"offset": sess.offset})
+            resp = await self._ingest_update(
+                client_id, bytes(sess.buf), wire.CONTENT_TYPE
+            )
+        finally:
+            sess.busy = False
+        if resp.status == 429:
+            # ingest queue full at assembly: keep the session — the
+            # retry re-sends only the (empty) final frame, not 100 MB
+            return resp
+        self._chunks.pop(key, None)
+        self.metrics.set_gauge("chunk_sessions_active", len(self._chunks))
+        if resp.status == 200:
+            self.metrics.inc("chunked_uploads_assembled")
+        return resp
+
+    async def handle_update_chunk_probe(
+        self, request: web.Request
+    ) -> web.Response:
+        """Committed-offset probe (GET; aiohttp serves HEAD from the
+        same route). An unknown session reports offset 0 — "start
+        over" and "never started" are the same instruction."""
+        try:
+            client_id = self.registry.verify(
+                request.query.get("client_id", ""), request.query.get("key", "")
+            )
+        except (UnknownClient, AuthError):
+            return web.json_response({"err": "Unauthorized"}, status=401)
+        sess = self._chunks.get((client_id, request.match_info["update_id"]))
+        offset = sess.offset if sess is not None else 0
+        return web.json_response(
+            {"offset": offset, "total": sess.total if sess else None},
+            headers={"Upload-Offset": str(offset)},
+        )
 
     def _validate_compressed_upload(self, tensors, anchor) -> None:
         """Structural check for a "<name>@idx"/"<name>@val" sparse-delta
@@ -822,6 +1168,11 @@ class Experiment:
     async def start_round(self, n_epoch: int) -> Dict[str, bool]:
         round_name = self.rounds.start_round(n_epoch=n_epoch)
         self._secure_round = None  # invalidate any stale secure state
+        # chunk sessions are per-round: a body assembled for the dead
+        # round would only 410 at ingest, so drop the buffers now
+        self._chunks.clear()
+        self.metrics.set_gauge("chunk_sessions_active", 0)
+        self._fold_rr = 0
         # _broadcasting must cover the WHOLE round setup — the secure
         # key/share phases included, not just the notify fan-out:
         # participants are only recorded at broadcast time, so a cull
@@ -855,7 +1206,7 @@ class Experiment:
         # and secure rounds only ever yield a masked SUM — both keep the
         # buffered path (self._stream_acc stays None).
         self._stream_acc = (
-            agg.StreamingMean()
+            self._new_stream_acc()
             if self.streaming_aggregation
             and self.aggregator[0] == "mean"
             and not self.secure_agg
@@ -1074,15 +1425,44 @@ class Experiment:
         if encoding is not None:
             envelope["encoding"] = encoding
         keep = [full_digest, self._prev_blob_digest]
+        prev_hop = self._prev_delta_hop
+        hop = None
         if delta_tensors is not None and full_digest != self._prev_blob_digest:
             delta_blob = wire.encode(delta_tensors, {})
             delta_digest = self._blobs.put(delta_blob, kind="delta")
-            envelope["delta"] = {
+            hop = {
                 "digest": delta_digest,
                 "size": len(delta_blob),
                 "from": self._prev_blob_digest,
+                "to": full_digest,
+            }
+            envelope["delta"] = {
+                k: hop[k] for k in ("digest", "size", "from")
             }
             keep.append(delta_digest)
+            # depth-2 delta chain: last round's delta blob still links
+            # into this round's anchor, so a worker anchored TWO rounds
+            # back (missed one round) chains anchor → N-1 → N through
+            # two small delta pulls instead of a full one. Each hop's
+            # reconstruction is digest-verified against its "to" — both
+            # hops are bit-defined the same way the depth-1 delta is.
+            if prev_hop is not None and prev_hop["to"] == hop["from"]:
+                envelope["delta_chain"] = [dict(prev_hop), dict(hop)]
+                keep.append(prev_hop["digest"])
+        elif (
+            delta_tensors is None
+            and full_digest == self._prev_blob_digest
+            and prev_hop is not None
+            and prev_hop["to"] == full_digest
+        ):
+            # params didn't move this round: last round's delta still
+            # ends at this round's blob, so a worker anchored two
+            # rounds back has a one-hop path — offer it directly
+            envelope["delta"] = {
+                k: prev_hop[k] for k in ("digest", "size", "from")
+            }
+            keep.append(prev_hop["digest"])
+            hop = prev_hop  # the chain stays alive
         self._blobs.retain(keep)
         if encoding is None:
             # dense broadcasts anchor the next round's delta; quantized
@@ -1090,9 +1470,11 @@ class Experiment:
             # doesn't speak, and the stochastic seed changes per round)
             self._prev_blob_sd = state_dict
             self._prev_blob_digest = full_digest
+            self._prev_delta_hop = hop
         else:
             self._prev_blob_sd = None
             self._prev_blob_digest = None
+            self._prev_delta_hop = None
         return envelope
 
     def _sample_cohort(self) -> list:
@@ -1407,6 +1789,11 @@ class Experiment:
         n_epoch = (self.rounds.round_meta or {}).get("n_epoch", 0)
         self.metrics.observe("round_s", self.rounds.elapsed)
         acc, self._stream_acc = self._stream_acc, None
+        if self._ingest is not None:
+            # an accepted update's 200 promised its fold would land in
+            # the mean; a forced finish (watchdog expiry, explicit
+            # end_round) must wait for folds already on the lanes
+            self._ingest.drain_folds()
         responses = self.rounds.end_round()
         self.metrics.inc("rounds_finished")
         reports = [r for r in responses.values() if r.get("n_samples", 0) > 0]

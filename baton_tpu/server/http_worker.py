@@ -131,6 +131,7 @@ class ExperimentWorker:
         compress: Optional[str] = None,
         outbox_backoff: Tuple[float, float] = (0.25, 10.0),
         outbox_dir: Optional[str] = None,
+        upload_chunk_bytes: Optional[int] = None,
     ):
         """``compress`` turns on sparse round-delta uploads
         (ops/compression.py): ``"topk:0.05"`` keeps the top 5% of delta
@@ -147,7 +148,14 @@ class ExperimentWorker:
         delivers the round's work after restart — closing the ROADMAP
         worker-crash gap. The error-feedback compressor residual is NOT
         persisted: after a crash-reload an abandoned update's kept mass
-        cannot be folded back (only delayed-delivery is durable)."""
+        cannot be folded back (only delayed-delivery is durable).
+
+        ``upload_chunk_bytes``: updates larger than this are delivered
+        as offset/total-framed ``PUT update_chunk`` frames with a
+        committed-offset probe, so a transfer that dies at 90% resumes
+        from the manager's committed prefix on the outbox's next
+        attempt instead of re-sending the whole body. ``None`` (the
+        default) keeps the single-POST path for every size."""
         self.name = name or getattr(model, "name", "fedmodel")
         self.model = model
         self.metrics = Metrics()
@@ -186,6 +194,12 @@ class ExperimentWorker:
         self.round_in_progress = False
         self.outbox_backoff = outbox_backoff
         self.outbox_dir = outbox_dir
+        if upload_chunk_bytes is not None and upload_chunk_bytes < 1:
+            raise ValueError(
+                f"upload_chunk_bytes must be >= 1 or None, "
+                f"got {upload_chunk_bytes}"
+            )
+        self.upload_chunk_bytes = upload_chunk_bytes
         self._pending: Optional[_PendingUpdate] = self._load_persisted()
         if self._pending is not None:
             self.metrics.set_gauge("outbox_pending", 1)
@@ -568,9 +582,12 @@ class ExperimentWorker:
             size = int(env["blob"]["size"])
             encoding = env.get("encoding") or {}
             delta_info = env.get("delta")
+            delta_chain = env.get("delta_chain")
         except Exception:
             return web.json_response({"err": "Bad Envelope"}, status=400)
-        tensors = await self._obtain_round_tensors(digest, size, delta_info)
+        tensors = await self._obtain_round_tensors(
+            digest, size, delta_info, delta_chain=delta_chain
+        )
         if tensors is None:
             # the manager's bounded notify fan-out naturally backpressures
             # these downloads; a 503 here lets it count the miss and
@@ -598,7 +615,7 @@ class ExperimentWorker:
         )
 
     async def _obtain_round_tensors(
-        self, digest: str, size: int, delta_info
+        self, digest: str, size: int, delta_info, delta_chain=None
     ) -> Optional[dict]:
         """The pull side of the data plane, cheapest source first:
 
@@ -606,7 +623,10 @@ class ExperimentWorker:
         2. the envelope offers a delta FROM our anchor → fetch the small
            delta blob, reconstruct ``anchor + delta``, and verify the
            reconstruction re-encodes to the round blob's digest;
-        3. otherwise (fresh worker, stale anchor, or verification
+        3. the envelope offers a delta CHAIN starting from our anchor (we
+           missed one round) → apply the hops in order, digest-verifying
+           each intermediate reconstruction;
+        4. otherwise (fresh worker, stale anchor, or verification
            failure) → fetch the full blob (Range-resumable).
         """
         if self._anchor_sd is not None and self._anchor_digest == digest:
@@ -646,6 +666,16 @@ class ExperimentWorker:
                 # reconstruction didn't hash to the round blob (anchor
                 # drift, corrupt delta): fall through to the full blob
                 self.metrics.inc("blob_delta_digest_mismatch")
+        if (
+            isinstance(delta_chain, list)
+            and delta_chain
+            and self._anchor_sd is not None
+            and isinstance(delta_chain[0], dict)
+            and delta_chain[0].get("from") == self._anchor_digest
+        ):
+            cand = await self._apply_delta_chain(delta_chain, digest)
+            if cand is not None:
+                return cand
         raw = await self._fetch_blob(digest, size)
         if raw is None:
             self.metrics.inc("blob_fetch_failed")
@@ -657,6 +687,49 @@ class ExperimentWorker:
             return None
         self.metrics.inc("blob_fetch_full")
         return tensors
+
+    async def _apply_delta_chain(
+        self, hops, final_digest: str
+    ) -> Optional[dict]:
+        """Walk a depth-N delta chain from our anchor: fetch each hop's
+        delta blob, reconstruct, and verify the intermediate state
+        re-encodes to the hop's ``to`` digest — every step is as
+        bit-defined as the single-hop delta path. Any failure returns
+        None and the caller falls back to the full blob."""
+        from baton_tpu.ops.compression import apply_delta_state_dict
+
+        sd = self._anchor_sd
+        to = None
+        for i, hop in enumerate(hops):
+            try:
+                ddigest = str(hop["digest"])
+                dsize = int(hop["size"])
+                to = str(
+                    hop["to"] if hop.get("to") is not None
+                    else (final_digest if i == len(hops) - 1 else "")
+                )
+            except (KeyError, TypeError, ValueError):
+                self.metrics.inc("blob_delta_digest_mismatch")
+                return None
+            raw = await self._fetch_blob(ddigest, dsize)
+            if raw is None:
+                self.metrics.inc("blob_delta_digest_mismatch")
+                return None
+            try:
+                delta_tensors, _ = wire.decode(raw)
+                cand = apply_delta_state_dict(sd, delta_tensors)
+                if hashlib.sha256(wire.encode(cand, {})).hexdigest() != to:
+                    raise ValueError("hop digest mismatch")
+            except Exception:
+                self.metrics.inc("blob_delta_digest_mismatch")
+                return None
+            sd = cand
+        if to != final_digest:
+            # chain ends at some other state (stale envelope): unusable
+            self.metrics.inc("blob_delta_digest_mismatch")
+            return None
+        self.metrics.inc("blob_fetch_delta_chain")
+        return sd
 
     async def _fetch_blob(
         self, digest: str, size: int, max_attempts: int = 6
@@ -683,6 +756,12 @@ class ExperimentWorker:
                     if resp.status in (200, 206):
                         async for chunk in resp.content.iter_chunked(1 << 16):
                             buf.extend(chunk)
+                            if len(buf) > size:
+                                # a server streaming MORE than the
+                                # envelope's declared size can never
+                                # verify — stop buffering it now instead
+                                # of after an unbounded read
+                                break
                     elif resp.status in (404, 410):
                         return None  # blob gone (round rolled): give up
                     else:
@@ -975,7 +1054,7 @@ class ExperimentWorker:
         if (
             not isinstance(meta, dict)
             or len(body) != meta.get("body_len")
-            or body[:4] != wire.MAGIC
+            or not wire.is_btw1(body)
         ):
             return None
         try:
@@ -1013,10 +1092,12 @@ class ExperimentWorker:
         """Retry the parked upload until the manager answers 200
         (delivered) or 410 (round dead): capped exponential backoff with
         jitter, re-registering on 401 so the retry after a manager
-        restart carries fresh credentials."""
+        restart carries fresh credentials. A 429's ``Retry-After`` is a
+        floor under the backoff — the manager's admission control is
+        authoritative about when to come back."""
         base, cap = self.outbox_backoff
         while (p := self._pending) is not None:
-            status = await self._post_update(p)
+            status, retry_after = await self._post_update(p)
             if self._pending is not p:
                 continue  # superseded while the POST was in flight
             if status == 200:
@@ -1031,21 +1112,47 @@ class ExperimentWorker:
                 # dropped from it): this update can never land
                 self._cancel_pending("round_gone")
                 continue
-            # undeliverable right now (connection refused, 5xx, 401):
-            # keep the slot and back off
+            # undeliverable right now (connection refused, 5xx, 401,
+            # 429 backpressure): keep the slot and back off
             p.attempts += 1
             self.metrics.inc("update_retries")
+            if status == 429:
+                self.metrics.inc("update_backpressure_429")
             if status == 401:
                 # manager restarted without its registry: rejoin, then
                 # retry the SAME update under the new credentials
                 await self.register_with_manager()
             delay = min(base * (2 ** (p.attempts - 1)), cap)
-            await asyncio.sleep(delay * (0.5 + random.random() / 2))
+            delay *= 0.5 + random.random() / 2
+            if retry_after is not None:
+                delay = max(delay, retry_after)
+            await asyncio.sleep(delay)
 
-    async def _post_update(self, p: _PendingUpdate) -> Optional[int]:
-        """One delivery attempt; the HTTP status or None on transport
-        failure. The URL is rebuilt per attempt: credentials may have
-        rotated via a 401 → re-register cycle between attempts."""
+    @staticmethod
+    def _retry_after_s(resp) -> Optional[float]:
+        """Parse a Retry-After header (seconds form) from a response;
+        None when absent/unparseable."""
+        val = resp.headers.get("Retry-After")
+        if val is None:
+            return None
+        try:
+            return max(0.0, float(val))
+        except ValueError:
+            return None
+
+    async def _post_update(
+        self, p: _PendingUpdate
+    ) -> Tuple[Optional[int], Optional[float]]:
+        """One delivery attempt; ``(status, retry_after_s)`` — status is
+        None on transport failure. The URL is rebuilt per attempt:
+        credentials may have rotated via a 401 → re-register cycle
+        between attempts. Bodies above ``upload_chunk_bytes`` go through
+        the chunked resumable path."""
+        if (
+            self.upload_chunk_bytes is not None
+            and len(p.body) > self.upload_chunk_bytes
+        ):
+            return await self._post_update_chunked(p)
         url = (
             self.manager_url
             + f"update?client_id={self.client_id}&key={self.key}"
@@ -1055,9 +1162,78 @@ class ExperimentWorker:
                 url, data=p.body,
                 headers={"Content-Type": wire.CONTENT_TYPE},
             ) as resp:
-                return resp.status
+                return resp.status, self._retry_after_s(resp)
         except (aiohttp.ClientError, asyncio.TimeoutError):
-            return None  # manager down; the backoff loop keeps trying
+            return None, None  # manager down; the backoff loop keeps trying
+
+    async def _post_update_chunked(
+        self, p: _PendingUpdate
+    ) -> Tuple[Optional[int], Optional[float]]:
+        """Deliver one update as offset/total-framed PUT chunks.
+
+        One attempt = a committed-offset probe + the remaining chunks in
+        order. A transport failure returns ``(None, None)`` and the
+        outbox backoff retries — the manager keeps the committed prefix,
+        so the next attempt's probe resumes where this one died instead
+        of re-sending the whole body. The final chunk's 200 IS the
+        update's acceptance ack."""
+        total = len(p.body)
+        base = (
+            self.manager_url
+            + f"update_chunk/{p.update_id}"
+            + f"?client_id={self.client_id}&key={self.key}"
+        )
+        try:
+            async with self._session.get(base) as resp:
+                if resp.status == 401:
+                    return 401, self._retry_after_s(resp)
+                if resp.status == 200:
+                    data = await resp.json()
+                    offset = max(0, min(int(data.get("offset", 0)), total))
+                else:
+                    offset = 0
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                TypeError, ValueError):
+            return None, None
+        if offset:
+            self.metrics.inc("chunk_upload_resumes")
+            self.metrics.inc("chunk_bytes_resume_skipped", offset)
+        resyncs = 0
+        while True:
+            end = min(offset + self.upload_chunk_bytes, total)
+            url = base + f"&offset={offset}&total={total}"
+            try:
+                self.metrics.inc("chunk_bytes_put", end - offset)
+                async with self._session.put(
+                    url, data=p.body[offset:end],
+                    headers={"Content-Type": wire.CONTENT_TYPE},
+                ) as resp:
+                    if resp.status == 409:
+                        # the manager's committed offset is authoritative
+                        resyncs += 1
+                        if resyncs > 8:
+                            return None, self._retry_after_s(resp)
+                        try:
+                            data = await resp.json()
+                            offset = max(
+                                0, min(int(data.get("offset", 0)), total)
+                            )
+                        except (TypeError, ValueError):
+                            return None, None
+                        continue
+                    if resp.status != 200:
+                        return resp.status, self._retry_after_s(resp)
+                    if end >= total:
+                        return 200, None
+                    try:
+                        data = await resp.json()
+                        offset = min(
+                            total, max(end, int(data.get("offset", end)))
+                        )
+                    except (TypeError, ValueError):
+                        offset = end
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                return None, None
 
     # ------------------------------------------------------------------
     def get_data(self) -> Tuple[dict, int]:

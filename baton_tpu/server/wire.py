@@ -102,6 +102,13 @@ def encode(tensors: Mapping[str, np.ndarray], meta: Dict[str, Any]) -> bytes:
     return bytes(out)
 
 
+def is_btw1(data) -> bool:
+    """Cheap magic sniff — admission paths (chunked-upload first frames,
+    disk-reloaded outbox slots) reject non-BTW1 bytes before buffering
+    or decoding anything."""
+    return bytes(data[:4]) == MAGIC
+
+
 def decode(data: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     """Parse BTW1 bytes → (tensors, meta). No code execution.
 
@@ -123,6 +130,14 @@ def decode(data: bytes) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
         (hdr_len,) = struct.unpack("<I", data[4:8])
     except struct.error as e:
         raise ValueError(f"truncated BTW1 header: {e}") from e
+    # explicit bounds check: a declared header length past the end of
+    # the buffer must fail as "truncated", not as whatever json makes of
+    # a silently-short slice
+    if 8 + hdr_len > len(data):
+        raise ValueError(
+            f"truncated BTW1 header: declares {hdr_len} bytes, "
+            f"{len(data) - 8} available"
+        )
     header = json.loads(data[8 : 8 + hdr_len].decode("utf-8"))
     # explicit structural validation: a crafted VALID-JSON header with
     # wrong types (null tensors, float shapes, string offsets) must hit

@@ -1,6 +1,19 @@
-"""Pull data plane at fan-out scale: downlink bytes/round and broadcast
-latency for C co-located workers on loopback, pull+delta vs the
-push-everything equivalent.
+"""Pull data plane at fan-out scale: downlink bytes/round, broadcast
+latency, and uplink ingest for C co-located workers on loopback.
+
+Three sections (``--sections downlink,uplink,resume``; skipped sections
+keep their previous numbers in the JSON):
+
+* ``downlink`` — pull+delta vs the push-everything equivalent (below);
+* ``uplink`` — C concurrent uploads into a streaming manager, measured
+  twice: ``ingest_workers=0`` (the old fully-inline path — decode,
+  validate, and fold all run on the event loop) vs the off-loop ingest
+  pipeline. A heartbeat probe runs through the same HTTP stack during
+  the burst; the section reports updates/s, MB/s, and heartbeat/ack
+  p50/p95 for both, plus the p95 ratio;
+* ``resume`` — a ~100 MB chunked upload killed at ~90% by a transport
+  drop, then resumed by a fresh worker from the manager's committed
+  offset; reports the fraction of the body transferred twice.
 
 What runs: a manager with ``broadcast_delta`` on and C ``EchoWorker``s
 (no jit training — each "round" perturbs local params slightly so every
@@ -203,7 +216,244 @@ async def _one_cohort(c: int, dim: int, rounds: int, delta_spec) -> dict:
     }
 
 
-async def _main(cohorts, dim, rounds, spec) -> dict:
+def _pct(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else None
+
+
+async def _uplink_once(
+    c: int, dim: int, ingest_workers: int, bursts: int = 3
+) -> dict:
+    """``bursts`` C-client concurrent upload waves into hand-driven
+    rounds, with a heartbeat probe hammering the same HTTP stack during
+    each wave — the probe's latency IS the event-loop responsiveness
+    the pipeline buys. Samples accumulate across waves so the p95 rests
+    on more than a handful of heartbeats."""
+    import aiohttp
+
+    model = linear_regression_model(dim, name="upbench")
+    mport = _free_port()
+    mapp = web.Application()
+    exp = Manager(mapp).register_experiment(
+        model, name="upbench", start_background_tasks=False,
+        streaming_aggregation=True, ingest_workers=ingest_workers,
+        ingest_queue_depth=max(64, 2 * c),
+    )
+    mrunner = web.AppRunner(mapp)
+    await mrunner.setup()
+    await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+    base = f"http://127.0.0.1:{mport}/upbench"
+
+    timeout = aiohttp.ClientTimeout(total=600.0)
+    session = aiohttp.ClientSession(timeout=timeout)
+    creds = []
+    for i in range(c):
+        async with session.get(f"{base}/register", json={"port": i + 1}) as r:
+            creds.append(await r.json())
+
+    rng = np.random.default_rng(0)
+    template = params_to_state_dict(exp.params)
+    hb_lat, ack_lat, walls = [], [], []
+    total_mb = 0.0
+    for burst in range(bursts):
+        round_name = exp.rounds.start_round(n_epoch=1)
+        exp._broadcast_anchor_sd = {
+            k: np.ascontiguousarray(np.asarray(v))
+            for k, v in params_to_state_dict(exp.params).items()
+        }
+        exp._stream_acc = exp._new_stream_acc()
+        for cr in creds:
+            exp.rounds.client_start(cr["client_id"])
+        bodies = []
+        for cr in creds:
+            sd = {k: rng.standard_normal(np.shape(v)).astype(np.float32)
+                  for k, v in template.items()}
+            bodies.append(wire.encode(sd, {
+                "update_name": round_name, "n_samples": 32.0,
+                "loss_history": [0.0],
+                "update_id": f"u{burst}-{cr['client_id']}",
+            }))
+        total_mb += sum(len(b) for b in bodies) / 1e6
+
+        stop = asyncio.Event()
+
+        async def probe():
+            hb_json = {"client_id": creds[0]["client_id"],
+                       "key": creds[0]["key"]}
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                async with session.get(
+                    f"{base}/heartbeat", json=hb_json
+                ) as r:
+                    assert r.status == 200
+                hb_lat.append(time.perf_counter() - t0)
+                await asyncio.sleep(0.003)
+
+        async def post_one(cr, body):
+            t0 = time.perf_counter()
+            async with session.post(
+                f"{base}/update?client_id={cr['client_id']}"
+                f"&key={cr['key']}",
+                data=body, headers={"Content-Type": wire.CONTENT_TYPE},
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+            ack_lat.append(time.perf_counter() - t0)
+
+        probe_task = asyncio.ensure_future(probe())
+        t0 = time.perf_counter()
+        await asyncio.gather(*[
+            post_one(cr, body) for cr, body in zip(creds, bodies)
+        ])
+        walls.append(time.perf_counter() - t0)
+        stop.set()
+        await probe_task
+
+    snap = exp.metrics.snapshot()["counters"]
+    assert snap.get("updates_received", 0) == c * bursts
+    assert snap.get("ingest_rejected_429", 0) == 0
+    await session.close()
+    await mrunner.cleanup()
+    wall = sum(walls)
+    return {
+        "ingest_workers": ingest_workers,
+        "bursts": bursts,
+        "updates_per_s": c * bursts / wall,
+        "uplink_mb_per_s": total_mb / wall,
+        "burst_wall_s": wall / bursts,
+        "heartbeat_p50_s": _pct(hb_lat, 0.50),
+        "heartbeat_p95_s": _pct(hb_lat, 0.95),
+        "heartbeat_samples": len(hb_lat),
+        "ack_p50_s": _pct(ack_lat, 0.50),
+        "ack_p95_s": _pct(ack_lat, 0.95),
+    }
+
+
+async def _uplink_section(c: int, dim: int) -> dict:
+    body_bytes = (dim + 1) * 4  # w + b, float32 (+ header noise)
+    print(f"[uplink] C={c}, ~{body_bytes / 1e6:.1f}MB/update, "
+          "ingest_workers=0 (inline baseline)...",
+          file=sys.stderr, flush=True)
+    baseline = await _uplink_once(c, dim, ingest_workers=0)
+    print("[uplink] pipelined (ingest_workers=4)...",
+          file=sys.stderr, flush=True)
+    pipelined = await _uplink_once(c, dim, ingest_workers=4)
+    out = {
+        "cohort": c,
+        "model_dim": dim,
+        "baseline_inline": baseline,
+        "pipelined": pipelined,
+        "heartbeat_p95_speedup_x":
+            baseline["heartbeat_p95_s"] / pipelined["heartbeat_p95_s"],
+        "ack_p95_speedup_x":
+            baseline["ack_p95_s"] / pipelined["ack_p95_s"],
+    }
+    print(f"[uplink] heartbeat p95: inline "
+          f"{baseline['heartbeat_p95_s'] * 1e3:.1f}ms -> pipelined "
+          f"{pipelined['heartbeat_p95_s'] * 1e3:.1f}ms "
+          f"({out['heartbeat_p95_speedup_x']:.1f}x)",
+          file=sys.stderr, flush=True)
+    return out
+
+
+async def _resume_section(resume_mb: int, chunk_mb: int) -> dict:
+    """Kill a ~resume_mb chunked upload at ~90% (transport drop, twice —
+    the client auto-retries an idempotent PUT once), restart the worker,
+    and measure how much of the body crossed the wire twice."""
+    from baton_tpu.server.http_worker import _PendingUpdate
+    from baton_tpu.utils.faults import FaultInjector
+
+    dim = resume_mb * (1 << 20) // 4
+    chunk = chunk_mb << 20
+    model = linear_regression_model(dim, name="resbench")
+    inj = FaultInjector()
+    mport = _free_port()
+    mapp = web.Application(middlewares=[inj.middleware])
+    exp = Manager(mapp).register_experiment(
+        model, name="resbench", start_background_tasks=False,
+        streaming_aggregation=True,
+    )
+    mrunner = web.AppRunner(mapp)
+    await mrunner.setup()
+    await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+    w1 = ExperimentWorker(
+        web.Application(), model, f"127.0.0.1:{mport}", name="resbench",
+        auto_register=False, upload_chunk_bytes=chunk,
+    )
+    await w1.register_with_manager()
+    round_name = exp.rounds.start_round(n_epoch=1)
+    exp._broadcast_anchor_sd = {
+        k: np.ascontiguousarray(np.asarray(v))
+        for k, v in params_to_state_dict(exp.params).items()
+    }
+    exp._stream_acc = exp._new_stream_acc()
+    exp.rounds.client_start(w1.client_id)
+
+    rng = np.random.default_rng(1)
+    template = params_to_state_dict(exp.params)
+    sd = {k: rng.standard_normal(np.shape(v)).astype(np.float32)
+          for k, v in template.items()}
+    body = wire.encode(sd, {
+        "update_name": round_name, "n_samples": 32.0,
+        "loss_history": [0.0], "update_id": "uid-resume",
+    })
+    total = len(body)
+    p = _PendingUpdate(round_name=round_name, update_id="uid-resume",
+                       body=body)
+    kill_offset = chunk * int(0.9 * total / chunk)
+    inj.drop(f"offset={kill_offset}&", times=2)
+
+    print(f"[resume] uploading {total / 1e6:.0f}MB in {chunk_mb}MB frames, "
+          f"killing at offset {kill_offset} "
+          f"({100 * kill_offset / total:.0f}%)...",
+          file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    status, _ = await w1._post_update_chunked(p)
+    first_wall = time.perf_counter() - t0
+    assert status is None, f"kill did not land (status={status})"
+    committed = exp._chunks[(w1.client_id, "uid-resume")].offset
+
+    w2 = ExperimentWorker(
+        web.Application(), model, f"127.0.0.1:{mport}", name="resbench",
+        auto_register=False, upload_chunk_bytes=chunk,
+    )
+    w2.client_id, w2.key = w1.client_id, w1.key
+    t0 = time.perf_counter()
+    status, _ = await w2._post_update_chunked(p)
+    resume_wall = time.perf_counter() - t0
+    assert status == 200, f"resume failed (status={status})"
+
+    def _ctr(w, name):
+        return w.metrics.snapshot()["counters"].get(name, 0.0)
+
+    put_total = _ctr(w1, "chunk_bytes_put") + _ctr(w2, "chunk_bytes_put")
+    retransfer = (put_total - total) / total
+    out = {
+        "body_bytes": total,
+        "chunk_bytes": chunk,
+        "killed_at_offset": kill_offset,
+        "killed_at_fraction": kill_offset / total,
+        "committed_at_kill": committed,
+        "resume_skipped_bytes": _ctr(w2, "chunk_bytes_resume_skipped"),
+        "bytes_put_total": put_total,
+        "retransfer_fraction": retransfer,
+        "first_attempt_wall_s": first_wall,
+        "resume_wall_s": resume_wall,
+        "assembled": exp.metrics.snapshot()["counters"].get(
+            "chunked_uploads_assembled", 0.0),
+    }
+    print(f"[resume] resumed from {committed} "
+          f"({100 * committed / total:.0f}%), retransferred "
+          f"{100 * retransfer:.1f}% of the body",
+          file=sys.stderr, flush=True)
+    await w1._on_cleanup()
+    await w2._on_cleanup()
+    await mrunner.cleanup()
+    return out
+
+
+async def _main(cohorts, dim, rounds, spec, sections, uplink_cohort,
+                uplink_dim, resume_mb, chunk_mb, prior) -> dict:
     out = {
         "benchmark": "dataplane_scale",
         "delta_spec": spec,
@@ -212,10 +462,18 @@ async def _main(cohorts, dim, rounds, spec) -> dict:
             "percentiles measure protocol + loopback scheduling, not a "
             "real network. Byte counts are exact."
         ),
-        "results": [],
+        "results": prior.get("results", []),
+        "uplink": prior.get("uplink"),
+        "chunk_resume": prior.get("chunk_resume"),
     }
-    for c in cohorts:
-        out["results"].append(await _one_cohort(c, dim, rounds, spec))
+    if "downlink" in sections:
+        out["results"] = []
+        for c in cohorts:
+            out["results"].append(await _one_cohort(c, dim, rounds, spec))
+    if "uplink" in sections:
+        out["uplink"] = await _uplink_section(uplink_cohort, uplink_dim)
+    if "resume" in sections:
+        out["chunk_resume"] = await _resume_section(resume_mb, chunk_mb)
     return out
 
 
@@ -225,6 +483,14 @@ if __name__ == "__main__":
     ap.add_argument("--dim", type=int, default=65536)
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--delta-spec", default="topk:0.05:q8")
+    ap.add_argument("--sections", default="downlink,uplink,resume",
+                    help="comma list; skipped sections keep the previous "
+                         "JSON's numbers")
+    ap.add_argument("--uplink-cohort", type=int, default=64)
+    ap.add_argument("--uplink-dim", type=int, default=1048576,
+                    help="model dim for the uplink burst (~4MB/update)")
+    ap.add_argument("--resume-mb", type=int, default=100)
+    ap.add_argument("--chunk-mb", type=int, default=4)
     ap.add_argument(
         "--out",
         default=os.path.join(os.path.dirname(__file__),
@@ -232,12 +498,36 @@ if __name__ == "__main__":
     )
     args = ap.parse_args()
     cohorts = [int(x) for x in args.cohorts.split(",") if x]
-    result = asyncio.run(_main(cohorts, args.dim, args.rounds,
-                               args.delta_spec))
+    sections = {s.strip() for s in args.sections.split(",") if s.strip()}
+    prior = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                prior = json.load(f)
+        except (OSError, ValueError):
+            prior = {}
+    result = asyncio.run(_main(
+        cohorts, args.dim, args.rounds, args.delta_spec, sections,
+        args.uplink_cohort, args.uplink_dim, args.resume_mb, args.chunk_mb,
+        prior,
+    ))
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
     for r in result["results"]:
         print(f"C={r['cohort']}: {r['downlink_reduction_x']:.1f}x downlink "
               f"reduction ({r['steady_bytes_down_per_round']:.0f}B vs "
               f"push {r['push_equiv_bytes_per_round']:.0f}B per round)")
+    if result.get("uplink"):
+        u = result["uplink"]
+        print(f"uplink C={u['cohort']}: heartbeat p95 "
+              f"{u['baseline_inline']['heartbeat_p95_s'] * 1e3:.1f}ms -> "
+              f"{u['pipelined']['heartbeat_p95_s'] * 1e3:.1f}ms "
+              f"({u['heartbeat_p95_speedup_x']:.1f}x), "
+              f"{u['pipelined']['uplink_mb_per_s']:.0f} MB/s ingested")
+    if result.get("chunk_resume"):
+        cr = result["chunk_resume"]
+        print(f"chunk resume: killed at "
+              f"{100 * cr['killed_at_fraction']:.0f}%, retransferred "
+              f"{100 * cr['retransfer_fraction']:.1f}% of "
+              f"{cr['body_bytes'] / 1e6:.0f}MB")
     print(f"wrote {args.out}")

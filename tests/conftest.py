@@ -26,3 +26,32 @@ import pytest  # noqa: E402
 @pytest.fixture
 def nprng():
     return np.random.default_rng(0)
+
+
+def counter(metrics, name, default=0.0):
+    """Read one counter from a Metrics registry (0.0 when never inc'd)."""
+    return metrics.snapshot()["counters"].get(name, default)
+
+
+@pytest.fixture
+def assert_counter():
+    """Shared metrics assertion: ``assert_counter(metrics, name, at_least=1)``
+    or ``assert_counter(metrics, name, equals=2)`` with a readable diff
+    listing every counter on failure (the ingest/backpressure tests all
+    assert on counters; one helper keeps the failure output uniform)."""
+
+    def check(metrics, name, at_least=None, equals=None):
+        counters = metrics.snapshot()["counters"]
+        got = counters.get(name, 0.0)
+        if equals is not None:
+            assert got == equals, (
+                f"counter {name}={got}, wanted == {equals}; all={counters}"
+            )
+        else:
+            want = 1.0 if at_least is None else at_least
+            assert got >= want, (
+                f"counter {name}={got}, wanted >= {want}; all={counters}"
+            )
+        return got
+
+    return check

@@ -1,0 +1,848 @@
+"""Pipelined uplink ingest: off-loop decode/fold, chunked resumable
+uploads, and backpressure.
+
+Covers the uplink contract added on top of the v2 pull data plane:
+
+* chunked ``PUT update_chunk`` framing — strict offset append, the
+  committed offset is authoritative (409 resync), the final frame's
+  response IS the acceptance response;
+* resume after a mid-upload kill (FaultInjector drop): the retry probes
+  the committed offset and re-sends <15% of the body;
+* admission control — 413 at the door (declared AND streamed), 429 +
+  ``Retry-After`` when the ingest queue or chunk-session table is full,
+  and the worker outbox honoring the Retry-After floor;
+* ``fold_shards`` partial accumulators merging to the same aggregate as
+  the sequential streaming fold and the buffered path;
+* resource exhaustion (MemoryError) NOT masked as a client 400;
+* the depth-2 downlink delta chain (a worker anchored two rounds back
+  reconstructs through two digest-verified delta hops).
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.ops import aggregation as agg
+from baton_tpu.ops.compression import (
+    apply_delta_state_dict,
+    delta_encode_state_dict,
+    parse_delta_spec,
+)
+from baton_tpu.server import wire
+from baton_tpu.server.blobs import blob_digest
+from baton_tpu.server.http_manager import Manager
+from baton_tpu.server.http_worker import ExperimentWorker, _PendingUpdate
+from baton_tpu.server.state import params_to_state_dict
+from baton_tpu.utils.faults import FaultInjector
+
+from conftest import counter
+
+
+def free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _hand_round(exp, client_ids, n_epoch=1):
+    """Drive the round state by hand (no reachable workers), the same
+    way the dataplane equivalence tests do."""
+    round_name = exp.rounds.start_round(n_epoch=n_epoch)
+    exp._broadcast_anchor_sd = {
+        k: np.ascontiguousarray(np.asarray(v))
+        for k, v in params_to_state_dict(exp.params).items()
+    }
+    if exp.streaming_aggregation:
+        exp._stream_acc = exp._new_stream_acc()
+    for cid in client_ids:
+        exp.rounds.client_start(cid)
+    return round_name
+
+
+async def _register(client, name, port=1):
+    resp = await client.get(f"/{name}/register", json={"port": port})
+    assert resp.status == 200
+    return await resp.json()
+
+
+def _upload_body(exp, round_name, rng, n_samples=8.0, update_id="u-1"):
+    template = params_to_state_dict(exp.params)
+    sd = {
+        k: np.asarray(rng.normal(size=np.shape(v)), np.float32)
+        for k, v in template.items()
+    }
+    body = wire.encode(sd, {
+        "update_name": round_name, "n_samples": n_samples,
+        "loss_history": [0.1], "update_id": update_id,
+    })
+    return sd, body
+
+
+# ----------------------------------------------------------------------
+# sharded streaming mean (unit)
+
+
+def test_sharded_streaming_mean_matches_sequential():
+    rng = np.random.default_rng(0)
+    template = {"w": (64, 8), "b": (8,)}
+    sds = [
+        {k: np.asarray(rng.normal(size=s), np.float32)
+         for k, s in template.items()}
+        for _ in range(16)
+    ]
+    weights = [float(w) for w in rng.integers(1, 100, size=16)]
+
+    seq = agg.StreamingMean()
+    shrd = agg.ShardedStreamingMean(4)
+    for i, (sd, w) in enumerate(zip(sds, weights)):
+        seq.add(sd, w)
+        shrd.add(sd, w, shard=i)  # round-robin via shard % 4
+    assert shrd.shards == 4
+    assert shrd.count == seq.count == 16
+    assert shrd.total_weight == pytest.approx(seq.total_weight)
+    got_s, got_q = seq.mean(), shrd.mean()
+    for k in template:
+        # merged partial sums == sequential fold up to fp32 reduction order
+        np.testing.assert_allclose(got_q[k], got_s[k], rtol=1e-5, atol=1e-6)
+
+    assert agg.ShardedStreamingMean(3).mean() is None
+    with pytest.raises(ValueError):
+        agg.ShardedStreamingMean(0)
+
+
+# ----------------------------------------------------------------------
+# chunked upload: roundtrip, probe, framing
+
+
+def test_chunked_upload_roundtrip_and_probe():
+    async def main():
+        app = web.Application()
+        exp = Manager(app).register_experiment(
+            linear_regression_model(64), name="chk",
+            start_background_tasks=False, streaming_aggregation=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        creds = await _register(client, "chk")
+        auth = f"client_id={creds['client_id']}&key={creds['key']}"
+        round_name = _hand_round(exp, [creds["client_id"]])
+        rng = np.random.default_rng(1)
+        sd, body = _upload_body(exp, round_name, rng, update_id="uid-chunk")
+        total = len(body)
+        step = total // 3 + 1
+
+        url = f"/chk/update_chunk/uid-chunk?{auth}"
+        offset = 0
+        while offset < total:
+            end = min(offset + step, total)
+            resp = await client.put(
+                f"{url}&offset={offset}&total={total}", data=body[offset:end]
+            )
+            assert resp.status == 200
+            if end < total:
+                data = await resp.json()
+                assert data["offset"] == end
+                # mid-transfer probe reports the committed offset
+                probe = await client.get(url)
+                pdata = await probe.json()
+                assert pdata == {"offset": end, "total": total}
+                assert probe.headers["Upload-Offset"] == str(end)
+            offset = end
+
+        snap = exp.metrics.snapshot()["counters"]
+        assert snap["chunked_uploads_assembled"] == 1
+        assert snap["updates_received"] == 1
+        assert snap["chunk_bytes_received"] == total
+        # the session is gone; the fold landed and (single participant)
+        # the round finished with the upload as the aggregate
+        assert not exp._chunks
+        assert not exp.rounds.in_progress
+        got = params_to_state_dict(exp.params)
+        for k in sd:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), sd[k], rtol=1e-5, atol=1e-6
+            )
+        # post-completion probe: committed offset is 0 again
+        pdata = await (await client.get(url)).json()
+        assert pdata == {"offset": 0, "total": None}
+        await client.close()
+
+    asyncio.run(main())
+
+
+def test_chunk_framing_rejections():
+    async def main():
+        app = web.Application()
+        exp = Manager(app).register_experiment(
+            linear_regression_model(32), name="frm",
+            start_background_tasks=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        creds = await _register(client, "frm")
+        auth = f"client_id={creds['client_id']}&key={creds['key']}"
+        round_name = _hand_round(exp, [creds["client_id"]])
+        _, body = _upload_body(
+            exp, round_name, np.random.default_rng(2), update_id="uid-f"
+        )
+        total = len(body)
+        url = f"/frm/update_chunk/uid-f?{auth}"
+
+        # bad credentials never reach framing
+        resp = await client.put(
+            f"/frm/update_chunk/uid-f?client_id=x&key=y&offset=0&total=8",
+            data=b"x",
+        )
+        assert resp.status == 401
+
+        # malformed framing: missing/non-int/negative/inverted
+        for qs in ("", "&offset=0", "&offset=a&total=9",
+                   "&offset=-1&total=9", "&offset=10&total=9",
+                   "&offset=0&total=0"):
+            resp = await client.put(url + qs, data=b"x")
+            assert resp.status == 400, qs
+            assert (await resp.json())["err"] == "Bad Chunk Framing"
+
+        # unknown session resuming mid-way: committed offset is 0
+        resp = await client.put(f"{url}&offset=64&total={total}", data=b"x")
+        assert resp.status == 409
+        assert (await resp.json())["offset"] == 0
+
+        # a non-BTW1 first frame is rejected before buffering anything
+        resp = await client.put(
+            f"{url}&offset=0&total={total}", data=b"\x00" * 64
+        )
+        assert resp.status == 400
+        assert (await resp.json())["err"] == "Bad Payload"
+        assert not exp._chunks
+
+        # open a real session with the first 100 bytes
+        resp = await client.put(
+            f"{url}&offset=0&total={total}", data=body[:100]
+        )
+        assert resp.status == 200 and (await resp.json())["offset"] == 100
+
+        # replaying an already-committed offset: 409 + where to resume
+        resp = await client.put(
+            f"{url}&offset=0&total={total}", data=body[:100]
+        )
+        assert resp.status == 409
+        assert (await resp.json())["offset"] == 100
+
+        # a frame overrunning the declared total is cut off (413)
+        resp = await client.put(
+            f"{url}&offset=100&total={total}", data=body[100:] + b"extra!"
+        )
+        assert resp.status == 413
+        assert (await resp.json())["err"] == "Chunk Overruns Total"
+
+        # inconsistent total poisons the session: dropped, start over
+        resp = await client.put(
+            f"{url}&offset=100&total={total + 4}", data=b"x"
+        )
+        assert resp.status == 400
+        assert (await resp.json())["err"] == "Inconsistent Total"
+        assert not exp._chunks
+
+        await client.close()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# 413 admission: declared, streamed, and chunk-total
+
+
+def test_upload_413_declared_streamed_and_chunked(assert_counter):
+    async def main():
+        app = web.Application()
+        exp = Manager(app).register_experiment(
+            linear_regression_model(8), name="cap",
+            start_background_tasks=False, max_upload_bytes=4096,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        creds = await _register(client, "cap")
+        auth = f"client_id={creds['client_id']}&key={creds['key']}"
+
+        # declared: Content-Length above the cap, rejected at the door
+        resp = await client.post(
+            f"/cap/update?{auth}", data=b"\x00" * 8192
+        )
+        assert resp.status == 413
+        assert_counter(exp.metrics, "uploads_rejected_413", equals=1)
+
+        # streamed: a chunked-TE client with no Content-Length is cut
+        # off as soon as the accumulated bytes pass the cap
+        async def drip():
+            for _ in range(16):
+                yield b"\x01" * 1024
+
+        resp = await client.post(f"/cap/update?{auth}", data=drip())
+        assert resp.status == 413
+        assert_counter(exp.metrics, "uploads_rejected_413", equals=2)
+
+        # chunk path: the whole upload is rejected on its FIRST frame by
+        # declared size, before buffering anything
+        resp = await client.put(
+            f"/cap/update_chunk/u1?{auth}&offset=0&total=999999",
+            data=b"\x00" * 16,
+        )
+        assert resp.status == 413
+        assert not exp._chunks
+        assert_counter(exp.metrics, "uploads_rejected_413", equals=3)
+        await client.close()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# 429 backpressure: ingest queue + chunk-session table + outbox floor
+
+
+def test_ingest_queue_full_returns_429_with_retry_after(assert_counter):
+    async def main():
+        app = web.Application()
+        exp = Manager(app).register_experiment(
+            linear_regression_model(8), name="bp",
+            start_background_tasks=False,
+            ingest_workers=1, ingest_queue_depth=1,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        creds = await _register(client, "bp")
+        auth = f"client_id={creds['client_id']}&key={creds['key']}"
+
+        # fill the (depth 1) admission window with a parked decode
+        gate = threading.Event()
+        fut = exp._ingest.submit_decode(gate.wait)
+        assert fut is not None
+        assert exp._ingest.inflight == 1
+
+        resp = await client.post(f"/bp/update?{auth}", data=b"irrelevant")
+        assert resp.status == 429
+        assert float(resp.headers["Retry-After"]) > 0
+        assert (await resp.json())["err"] == "Ingest Queue Full"
+        assert_counter(exp.metrics, "ingest_rejected_429", equals=1)
+
+        # releasing the parked decode reopens admission (the next POST
+        # reaches the decoder — garbage now 400s instead of 429ing)
+        gate.set()
+        await fut
+        resp = await client.post(f"/bp/update?{auth}", data=b"irrelevant")
+        assert resp.status == 400
+        assert_counter(exp.metrics, "ingest_rejected_429", equals=1)
+        await client.close()
+
+    asyncio.run(main())
+
+
+def test_chunk_session_table_full_returns_429():
+    async def main():
+        app = web.Application()
+        exp = Manager(app).register_experiment(
+            linear_regression_model(32), name="tbl",
+            start_background_tasks=False, max_chunk_sessions=1,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        c1 = await _register(client, "tbl", port=1)
+        c2 = await _register(client, "tbl", port=2)
+        round_name = _hand_round(exp, [c1["client_id"], c2["client_id"]])
+        _, body = _upload_body(
+            exp, round_name, np.random.default_rng(3), update_id="uid-t"
+        )
+        total = len(body)
+
+        resp = await client.put(
+            f"/tbl/update_chunk/uid-t?client_id={c1['client_id']}"
+            f"&key={c1['key']}&offset=0&total={total}",
+            data=body[:100],
+        )
+        assert resp.status == 200  # session 1 of 1 open
+
+        resp = await client.put(
+            f"/tbl/update_chunk/uid-t?client_id={c2['client_id']}"
+            f"&key={c2['key']}&offset=0&total={total}",
+            data=body[:100],
+        )
+        assert resp.status == 429
+        assert "Retry-After" in resp.headers
+        assert (await resp.json())["err"] == "Too Many Chunk Sessions"
+
+        # a round roll clears the table (the REAL start_round path —
+        # sessions are per-round; the clients are unreachable so the new
+        # round aborts after the notify, but the clear happens first)
+        exp.rounds.abort_round()
+        resp = await client.get("/tbl/start_round?n_epoch=1")
+        assert resp.status == 200
+        assert not exp._chunks
+        await client.close()
+
+    asyncio.run(main())
+
+
+def test_outbox_honors_retry_after_floor(assert_counter):
+    """A 429's Retry-After is a floor under the outbox backoff: with a
+    tiny (0.01s, 0.02s) backoff configured, the redelivery still waits
+    the manager-mandated 0.8s."""
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        hits = []
+
+        async def update_handler(request):
+            await request.read()
+            hits.append(loop.time())
+            if len(hits) == 1:
+                return web.json_response(
+                    {"err": "busy"}, status=429,
+                    headers={"Retry-After": "0.8"},
+                )
+            return web.json_response("OK")
+
+        mport = free_port()
+        mapp = web.Application()
+        mapp.router.add_post("/ob/update", update_handler)
+        mrunner = web.AppRunner(mapp)
+        await mrunner.setup()
+        await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+        w = ExperimentWorker(
+            web.Application(), linear_regression_model(4),
+            f"127.0.0.1:{mport}", name="ob", auto_register=False,
+            outbox_backoff=(0.01, 0.02),
+        )
+        w.client_id, w.key = "c", "k"
+        w._enqueue_update(_PendingUpdate(
+            round_name="r", update_id="u", body=b"BTW1-ish",
+        ))
+        for _ in range(200):
+            if w._pending is None:
+                break
+            await asyncio.sleep(0.02)
+        assert w._pending is None
+        assert len(hits) == 2
+        assert hits[1] - hits[0] >= 0.7  # floored by Retry-After, not 0.02
+        assert_counter(w.metrics, "update_backpressure_429", equals=1)
+        assert_counter(w.metrics, "updates_delivered", equals=1)
+        await w._on_cleanup()
+        await mrunner.cleanup()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# resume after a mid-upload kill
+
+
+def test_chunk_upload_resumes_after_midupload_kill(assert_counter):
+    """A 100 KB-scale upload dies at ~90% (FaultInjector drops the
+    transport mid-frame, before any byte of that frame commits). The
+    restarted worker probes the committed offset and re-sends <15% of
+    the body; the manager accepts the assembled update exactly once."""
+
+    async def main():
+        inj = FaultInjector()
+        mport = free_port()
+        mapp = web.Application(middlewares=[inj.middleware])
+        exp = Manager(mapp).register_experiment(
+            linear_regression_model(25_000), name="res",
+            start_background_tasks=False, streaming_aggregation=True,
+        )
+        mrunner = web.AppRunner(mapp)
+        await mrunner.setup()
+        await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+        chunk = 8192
+        w1 = ExperimentWorker(
+            web.Application(), linear_regression_model(25_000),
+            f"127.0.0.1:{mport}", name="res", auto_register=False,
+            upload_chunk_bytes=chunk,
+        )
+        await w1.register_with_manager()
+        round_name = _hand_round(exp, [w1.client_id])
+        sd, body = _upload_body(
+            exp, round_name, np.random.default_rng(4), update_id="uid-res"
+        )
+        total = len(body)
+        p = _PendingUpdate(
+            round_name=round_name, update_id="uid-res", body=body
+        )
+
+        # kill the transfer on the frame starting at ~90% of the body.
+        # times=2: the client auto-retries an idempotent PUT whose
+        # reused keep-alive connection died, so a single drop would be
+        # healed transparently — a dead worker stays dead
+        kill_offset = chunk * int(0.9 * total / chunk)
+        assert 0 < kill_offset < total
+        rule = inj.drop(f"offset={kill_offset}&", times=2)
+
+        status, retry_after = await w1._post_update_chunked(p)
+        assert (status, retry_after) == (None, None)  # transport death
+        assert rule.hits == 2
+        # the dropped frame never committed: the manager holds exactly
+        # the pre-kill prefix
+        sess = exp._chunks[(w1.client_id, "uid-res")]
+        assert sess.offset == kill_offset
+
+        # "restart": a fresh worker process with the same identity and
+        # the same parked outbox body
+        w2 = ExperimentWorker(
+            web.Application(), linear_regression_model(25_000),
+            f"127.0.0.1:{mport}", name="res", auto_register=False,
+            upload_chunk_bytes=chunk,
+        )
+        w2.client_id, w2.key = w1.client_id, w1.key
+        status, retry_after = await w2._post_update_chunked(p)
+        assert status == 200
+
+        assert_counter(w2.metrics, "chunk_upload_resumes", equals=1)
+        assert_counter(
+            w2.metrics, "chunk_bytes_resume_skipped", equals=kill_offset
+        )
+        # retransfer accounting: everything PUT across both attempts
+        # beyond one body-length is waste — only the killed frame
+        put_total = counter(w1.metrics, "chunk_bytes_put") + counter(
+            w2.metrics, "chunk_bytes_put"
+        )
+        retransfer = (put_total - total) / total
+        assert retransfer < 0.15, (put_total, total, retransfer)
+
+        assert_counter(exp.metrics, "chunked_uploads_assembled", equals=1)
+        assert_counter(exp.metrics, "updates_received", equals=1)
+        assert not exp._chunks
+        got = params_to_state_dict(exp.params)
+        for k in sd:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), sd[k], rtol=1e-5, atol=1e-6
+            )
+
+        await w1._on_cleanup()
+        await w2._on_cleanup()
+        await mrunner.cleanup()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# fold_shards: end-to-end HTTP equivalence
+
+
+def test_fold_shards_matches_sequential_and_buffered():
+    """The same five uploads through a fold_shards=3 streaming
+    experiment, a sequential streaming one, and a buffered one land on
+    the same aggregate within fp32 tolerance."""
+
+    async def main():
+        app = web.Application()
+        manager = Manager(app)
+        exps = {
+            "shrd": manager.register_experiment(
+                linear_regression_model(48), name="shrd",
+                start_background_tasks=False, streaming_aggregation=True,
+                fold_shards=3,
+            ),
+            "seqs": manager.register_experiment(
+                linear_regression_model(48), name="seqs",
+                start_background_tasks=False, streaming_aggregation=True,
+            ),
+            "buff": manager.register_experiment(
+                linear_regression_model(48), name="buff",
+                start_background_tasks=False, streaming_aggregation=False,
+            ),
+        }
+        assert isinstance(
+            exps["shrd"]._new_stream_acc(), agg.ShardedStreamingMean
+        )
+        assert isinstance(exps["seqs"]._new_stream_acc(), agg.StreamingMean)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+
+        rng = np.random.default_rng(5)
+        template = params_to_state_dict(exps["shrd"].params)
+        uploads = [
+            (
+                {k: np.asarray(rng.normal(size=np.shape(v)), np.float32)
+                 for k, v in template.items()},
+                float(n),
+            )
+            for n in (8, 24, 3, 17, 40)
+        ]
+
+        for label, exp in exps.items():
+            creds = [
+                await _register(client, label, port=i + 1)
+                for i in range(len(uploads))
+            ]
+            round_name = _hand_round(
+                exp, [c["client_id"] for c in creds]
+            )
+            for (sd, n), c in zip(uploads, creds):
+                body = wire.encode(sd, {
+                    "update_name": round_name, "n_samples": n,
+                    "loss_history": [0.1],
+                    "update_id": f"u-{c['client_id']}",
+                })
+                resp = await client.post(
+                    f"/{label}/update?client_id={c['client_id']}"
+                    f"&key={c['key']}",
+                    data=body, headers={"Content-Type": wire.CONTENT_TYPE},
+                )
+                assert resp.status == 200
+
+        # every shard lane actually folded something
+        assert counter(exps["shrd"].metrics, "updates_received") == 5
+        sd_ref = params_to_state_dict(exps["buff"].params)
+        for label in ("shrd", "seqs"):
+            got = params_to_state_dict(exps[label].params)
+            for k in sd_ref:
+                np.testing.assert_allclose(
+                    np.asarray(got[k]), np.asarray(sd_ref[k]),
+                    rtol=1e-5, atol=1e-6,
+                )
+        await client.close()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# narrowed error handling
+
+
+def test_memoryerror_is_not_masked_as_client_400(monkeypatch):
+    """Resource exhaustion in decode must surface as a 500, not a 400
+    'Bad Payload' that invites the client to retry forever."""
+
+    async def main():
+        app = web.Application()
+        Manager(app).register_experiment(
+            linear_regression_model(8), name="oom",
+            start_background_tasks=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        creds = await _register(client, "oom")
+        auth = f"client_id={creds['client_id']}&key={creds['key']}"
+
+        def boom(*a, **kw):
+            raise MemoryError("decode allocation failed")
+
+        monkeypatch.setattr(wire, "decode_any", boom)
+        resp = await client.post(f"/oom/update?{auth}", data=b"whatever")
+        assert resp.status == 500
+        monkeypatch.undo()
+
+        # while genuinely malformed bytes stay a client 400
+        resp = await client.post(f"/oom/update?{auth}", data=b"garbage")
+        assert resp.status == 400
+        assert (await resp.json())["err"] == "Bad Payload"
+        await client.close()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# depth-2 downlink delta chain
+
+
+def _rand_sd(rng, shape=(64, 8)):
+    return {
+        "w": np.asarray(rng.normal(size=shape), np.float32),
+        "b": np.asarray(rng.normal(size=shape[-1:]), np.float32),
+    }
+
+
+def _step(rng, sd, scale=0.05):
+    target = {
+        k: v + np.asarray(rng.normal(size=v.shape) * scale, np.float32)
+        for k, v in sd.items()
+    }
+    delta = delta_encode_state_dict(sd, target, parse_delta_spec("topk:1.0"))
+    # the broadcast is DEFINED as the reconstruction
+    return apply_delta_state_dict(sd, delta), delta
+
+
+def _stub_worker(blobs):
+    w = ExperimentWorker(
+        web.Application(), linear_regression_model(4), "127.0.0.1:1",
+        name="stub", auto_register=False,
+    )
+    log = []
+
+    async def fake_fetch(digest, size, max_attempts=6):
+        log.append(digest)
+        data = blobs.get(digest)
+        if data is None or len(data) != size:
+            return None
+        return data
+
+    w._fetch_blob = fake_fetch
+    return w, log
+
+
+def test_delta_chain_depth2_envelope_and_worker_reconstruction():
+    async def main():
+        app = web.Application()
+        exp = Manager(app).register_experiment(
+            linear_regression_model(4), name="dc",
+            start_background_tasks=False,
+        )
+        rng = np.random.default_rng(6)
+        sd0 = _rand_sd(rng)
+        sd1, delta01 = _step(rng, sd0)
+        sd2, delta12 = _step(rng, sd1)
+        d0 = blob_digest(wire.encode(sd0, {}))
+        d1 = blob_digest(wire.encode(sd1, {}))
+        d2 = blob_digest(wire.encode(sd2, {}))
+
+        env1 = exp._publish_round_blobs("r1", 1, sd0, None, None)
+        assert "delta" not in env1 and "delta_chain" not in env1
+
+        # round 2: first delta round — depth-1 only (no previous hop)
+        env2 = exp._publish_round_blobs("r2", 1, sd1, delta01, None)
+        assert env2["delta"]["from"] == d0
+        assert "delta_chain" not in env2
+        d01 = env2["delta"]["digest"]
+
+        # round 3: last round's delta still links into this round's
+        # anchor — the envelope carries the two-hop chain
+        env3 = exp._publish_round_blobs("r3", 1, sd2, delta12, None)
+        assert env3["blob"]["digest"] == d2
+        assert env3["delta"]["from"] == d1
+        chain = env3["delta_chain"]
+        assert [h["from"] for h in chain] == [d0, d1]
+        assert [h["to"] for h in chain] == [d1, d2]
+        d12 = env3["delta"]["digest"]
+        # retention kept both hop blobs
+        assert d01 in exp._blobs and d12 in exp._blobs
+
+        blobs = {
+            dg: exp._blobs.get(dg)[0]
+            for dg in (d01, d12, d1, d2)
+        }
+
+        # a worker anchored TWO rounds back (missed r2) chains
+        # anchor -> r2 -> r3 through two small delta pulls, each hop
+        # digest-verified; the full blob is never requested
+        w, log = _stub_worker(blobs)
+        w._anchor_sd, w._anchor_digest = dict(sd0), d0
+        got = await w._obtain_round_tensors(
+            d2, len(blobs[d2]), env3["delta"], delta_chain=chain
+        )
+        assert log == [d01, d12]
+        for k in sd2:
+            np.testing.assert_array_equal(got[k], sd2[k])
+        snap = w.metrics.snapshot()["counters"]
+        assert snap["blob_fetch_delta_chain"] == 1
+        assert "blob_fetch_full" not in snap
+
+        # a worker anchored one round back still takes the depth-1 path
+        w, log = _stub_worker(blobs)
+        w._anchor_sd, w._anchor_digest = dict(sd1), d1
+        got = await w._obtain_round_tensors(
+            d2, len(blobs[d2]), env3["delta"], delta_chain=chain
+        )
+        assert log == [d12]
+        assert w.metrics.snapshot()["counters"]["blob_fetch_delta"] == 1
+
+        # a broken chain (hop blob gone) falls back to the full blob
+        w, log = _stub_worker({d12: blobs[d12], d2: blobs[d2]})
+        w._anchor_sd, w._anchor_digest = dict(sd0), d0
+        got = await w._obtain_round_tensors(
+            d2, len(blobs[d2]), env3["delta"], delta_chain=chain
+        )
+        assert log == [d01, d2]
+        for k in sd2:
+            np.testing.assert_array_equal(got[k], sd2[k])
+        snap = w.metrics.snapshot()["counters"]
+        assert snap["blob_delta_digest_mismatch"] == 1
+        assert snap["blob_fetch_full"] == 1
+
+        # params unchanged this round: last round's delta still ends at
+        # this round's blob, offered directly as the depth-1 delta
+        env4 = exp._publish_round_blobs("r4", 1, sd2, None, None)
+        assert env4["blob"]["digest"] == d2
+        assert env4["delta"]["digest"] == d12
+        assert env4["delta"]["from"] == d1
+        assert "delta_chain" not in env4
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# event-loop responsiveness under concurrent ingest
+
+
+@pytest.mark.slow
+def test_event_loop_stays_responsive_during_concurrent_ingest():
+    """With decode/fold off-loop, concurrent multi-MB uploads must not
+    starve the event loop: a heartbeat-cadence probe sleeping 5 ms keeps
+    a loose p95 bound while 8 x 2 MB uploads decode and fold."""
+
+    async def main():
+        app = web.Application()
+        exp = Manager(app).register_experiment(
+            linear_regression_model(500_000), name="hb",
+            start_background_tasks=False, streaming_aggregation=True,
+            ingest_workers=4,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        creds = [await _register(client, "hb", port=i + 1) for i in range(8)]
+        round_name = _hand_round(exp, [c["client_id"] for c in creds])
+        rng = np.random.default_rng(7)
+        template = params_to_state_dict(exp.params)
+        bodies = []
+        for c in creds:
+            sd = {k: np.asarray(rng.normal(size=np.shape(v)), np.float32)
+                  for k, v in template.items()}
+            bodies.append(wire.encode(sd, {
+                "update_name": round_name, "n_samples": 8.0,
+                "loss_history": [0.1], "update_id": f"u-{c['client_id']}",
+            }))
+
+        lags = []
+        stop = asyncio.Event()
+
+        async def probe():
+            loop = asyncio.get_running_loop()
+            while not stop.is_set():
+                t0 = loop.time()
+                await asyncio.sleep(0.005)
+                lags.append(loop.time() - t0 - 0.005)
+
+        probe_task = asyncio.ensure_future(probe())
+        results = await asyncio.gather(*[
+            client.post(
+                f"/hb/update?client_id={c['client_id']}&key={c['key']}",
+                data=body, headers={"Content-Type": wire.CONTENT_TYPE},
+            )
+            for c, body in zip(creds, bodies)
+        ])
+        stop.set()
+        await probe_task
+        assert all(r.status == 200 for r in results)
+        assert counter(exp.metrics, "updates_received") == 8
+
+        lags.sort()
+        p95 = lags[int(0.95 * (len(lags) - 1))]
+        # loose absolute bound: on-loop decode of 8 x 2 MB bodies stalls
+        # the loop for whole decode+fold spans; off-loop it stays at
+        # scheduling-noise level (the 3x ratio claim is measured by
+        # benchmarks/dataplane_scale.py, not asserted here)
+        assert p95 < 0.25, f"p95 loop lag {p95:.3f}s over {len(lags)} samples"
+        # decode/fold timers actually ran off-loop
+        timers = exp.metrics.snapshot()["timers"]
+        assert timers["ingest_decode_s"]["count"] == 8
+        assert timers["ingest_fold_s"]["count"] == 8
+        await client.close()
+
+    asyncio.run(main())
