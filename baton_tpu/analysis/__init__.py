@@ -1,0 +1,44 @@
+"""batonlint — project-native static analysis for baton_tpu.
+
+PRs 1-3 bought durability and a pipelined data plane by enforcing
+delicate conventions *by hand*: no blocking decode/fold work on the
+asyncio event loop, every request body read through a byte cap, no
+``await`` of network primitives while holding a state lock, no Python
+side effects inside ``jit``/``shard_map``-traced functions, and a
+metrics-counter namespace that matches the declared registry. Nothing
+checked any of that — ``http_worker.py`` regressed to an uncapped
+``await request.read()`` within one PR of the cap landing.
+
+This package is the machine enforcement: a stdlib-``ast`` lint engine
+(:mod:`~baton_tpu.analysis.engine`) with a checker registry, per-line
+suppressions (``# batonlint: allow[RULE]``), text/JSON reporters, and a
+CLI (``python -m baton_tpu.analysis [paths]``). Rules:
+
+=======  ==============================================================
+BTL001   blocking call (file I/O, ``time.sleep``, ``pickle.loads``,
+         ``zlib.*``, ``.block_until_ready()``, ``jax.device_get``)
+         reachable from an ``async def`` in ``baton_tpu/server/``
+BTL002   ``await`` of a network/queue primitive while holding an
+         asyncio lock; cross-function lock-acquisition-order conflicts
+BTL010   tracer hygiene inside ``@jax.jit``/``shard_map`` functions
+         (``print``, ``.item()``, ``float()``/``int()`` on traced
+         values, ``np.asarray``, module-state mutation)
+BTL020   raw ``request.read()`` / uncapped ``request.json()`` in an
+         aiohttp handler (use ``utils.read_body_capped`` /
+         ``utils.read_json_capped``)
+BTL030   metrics counter used in ``server/`` but not declared in
+         ``baton_tpu/utils/metrics.py``
+=======  ==============================================================
+
+The repo itself must stay lint-clean: ``tests/test_analysis.py::
+test_repo_is_lint_clean`` runs this engine over ``baton_tpu/`` and
+asserts zero findings, and CI runs the CLI before the test suite.
+"""
+
+from baton_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    Report,
+    all_rules,
+    run_paths,
+    run_source,
+)

@@ -1,0 +1,72 @@
+"""batonlint CLI: ``python -m baton_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 engine/usage errors — so CI can
+fail the build on any finding while distinguishing broken invocations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from baton_tpu.analysis.engine import (
+    all_rules,
+    format_json,
+    format_text,
+    run_paths,
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m baton_tpu.analysis",
+        description=(
+            "batonlint: AST invariant checks for event-loop, wire-cap, "
+            "lock, JAX-tracer, and metrics hygiene"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["baton_tpu"],
+        help="files or directories to lint (default: baton_tpu)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="report format (default: text)",
+    )
+    parser.add_argument(
+        "--select",
+        action="append",
+        metavar="RULE",
+        help="run only these rule ids (repeatable, e.g. --select BTL020)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule table and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, title in all_rules().items():
+            print(f"{rule}  {title}")
+        return 0
+
+    try:
+        report = run_paths(args.paths, rules=args.select)
+    except KeyError as exc:
+        print(f"batonlint: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    print(format_json(report) if args.format == "json" else format_text(report))
+    if report.errors:
+        return 2
+    return 0 if not report.findings else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+"""Shared AST helpers for the batonlint checkers."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional
+
+_FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None (calls,
+    subscripts and other dynamic receivers don't resolve statically)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base is not None else None
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def walk_shallow(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function
+    definitions or lambdas.
+
+    Nested defs are separate execution contexts — in async code they
+    are typically closures handed to ``to_thread``/``run_in_executor``
+    (so blocking work inside them is exactly the sanctioned routing),
+    and they get their own analysis where relevant.
+    """
+    todo = list(ast.iter_child_nodes(node))
+    while todo:
+        child = todo.pop()
+        yield child
+        if not isinstance(child, _FUNCTION_NODES):
+            todo.extend(ast.iter_child_nodes(child))
+
+
+def iter_function_defs(tree: ast.AST) -> Iterator[tuple]:
+    """Yield ``(qualname, class_name, node)`` for every def/async def.
+
+    ``qualname`` is ``Class.method`` for methods, the bare name
+    otherwise (nested functions keep their own bare name — good enough
+    for same-module call-graph resolution).
+    """
+
+    def visit(node: ast.AST, class_name: Optional[str]) -> Iterator[tuple]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = (
+                    f"{class_name}.{child.name}" if class_name else child.name
+                )
+                yield qual, class_name, child
+                yield from visit(child, class_name)
+            else:
+                yield from visit(child, class_name)
+
+    yield from visit(tree, None)
+
+
+def sync_function_index(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    """``{qualname: node}`` for plain (non-async) defs — the targets a
+    same-module call-graph walk can resolve."""
+    return {
+        qual: node
+        for qual, _cls, node in iter_function_defs(tree)
+        if isinstance(node, ast.FunctionDef)
+    }
+
+
+def resolve_local_call(
+    call: ast.Call, class_name: Optional[str]
+) -> Optional[str]:
+    """Map a call expression to a same-module qualname candidate:
+    ``self.helper(...)`` -> ``Class.helper``; ``helper(...)`` ->
+    ``helper``. Anything else (other objects, dynamic) -> None."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id
+    if (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Name)
+        and func.value.id in ("self", "cls")
+        and class_name is not None
+    ):
+        return f"{class_name}.{func.attr}"
+    return None
+
+
+def param_names(node) -> set:
+    args = node.args
+    names = [
+        a.arg
+        for a in (
+            list(getattr(args, "posonlyargs", []))
+            + list(args.args)
+            + list(args.kwonlyargs)
+        )
+    ]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)

@@ -1,0 +1,17 @@
+"""batonlint rule modules — importing this package registers them all.
+
+Adding a checker: create a module here, subclass
+:class:`baton_tpu.analysis.engine.Checker`, decorate it with
+``@register``, and import the module below. Give the rule a stable
+``BTLxxx`` id (001-009 event-loop, 010-019 JAX, 020-029 wire, 030-039
+observability) and add known-bad/known-good fixtures to
+``tests/test_analysis.py``.
+"""
+
+from baton_tpu.analysis.checkers import (  # noqa: F401
+    blocking,
+    counters,
+    locks,
+    tracer,
+    wirecap,
+)

@@ -1,0 +1,99 @@
+"""BTL030 — metrics counter names must be declared in the registry.
+
+Dashboards and the ops alert rules key on exact counter names; a typo
+at an ``metrics.inc("updates_recieved")`` call site silently forks the
+series and the alert never fires. Every counter name used under
+``server/`` must appear in ``DECLARED_COUNTERS`` (or match a prefix in
+``DECLARED_COUNTER_PREFIXES``, for families built with f-strings) in
+``baton_tpu/utils/metrics.py``.
+
+The registry is parsed as AST literals by the engine — linting never
+imports package code — and handed to this checker via
+``ctx.counter_registry``. Dynamic counter names (f-strings, variables)
+are checked against the declared prefixes when the static prefix of
+the f-string resolves, and skipped otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from baton_tpu.analysis.engine import Checker, CheckContext, Finding, register
+
+_INC_METHODS = {"inc"}
+
+
+def _static_prefix(node: ast.AST) -> Optional[str]:
+    """The compile-time-known leading text of a counter-name argument:
+    the whole string for a constant, the leading literal chunk for an
+    f-string, None when nothing is statically known."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return None
+
+
+@register
+class CounterRegistryChecker(Checker):
+    rule = "BTL030"
+    title = "metrics counter not declared in utils/metrics.py registry"
+
+    def applies_to(self, ctx: CheckContext) -> bool:
+        return "server" in ctx.parts and ctx.counter_registry is not None
+
+    def check(self, ctx: CheckContext) -> Iterable[Finding]:
+        declared, prefixes = ctx.counter_registry
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not (
+                isinstance(func, ast.Attribute)
+                and func.attr in _INC_METHODS
+                and node.args
+            ):
+                continue
+            # a conditional name picks one of two counters at runtime:
+            # check each branch ("a" if cond else "b")
+            stack, args = [node.args[0]], []
+            while stack:
+                a = stack.pop()
+                if isinstance(a, ast.IfExp):
+                    stack.extend((a.body, a.orelse))
+                else:
+                    args.append(a)
+            for arg in args:
+                is_exact = isinstance(arg, ast.Constant)
+                prefix = _static_prefix(arg)
+                if prefix is None:
+                    continue  # fully dynamic name; nothing checkable
+                if is_exact:
+                    if prefix in declared or any(
+                        prefix.startswith(p) for p in prefixes
+                    ):
+                        continue
+                else:
+                    # f-string family: its literal head must extend one
+                    # of the declared prefixes (or a declared prefix
+                    # must extend it, for short heads like f"up_{x}")
+                    if any(
+                        prefix.startswith(p) or p.startswith(prefix)
+                        for p in prefixes
+                    ):
+                        continue
+                findings.append(
+                    Finding(
+                        self.rule, ctx.path, node.lineno, node.col_offset,
+                        f"counter `{prefix}{'' if is_exact else '...'}` "
+                        f"is not declared in DECLARED_COUNTERS"
+                        f"{'' if is_exact else ' / DECLARED_COUNTER_PREFIXES'}"
+                        f" (baton_tpu/utils/metrics.py); declare it or "
+                        f"fix the typo",
+                    )
+                )
+        return findings

@@ -1,0 +1,188 @@
+"""BTL002 — awaits under asyncio locks, and lock-order conflicts.
+
+Holding a state lock across a network/queue await is a liveness
+hazard: every other coroutine needing the lock stalls for a peer's
+round-trip (or forever, against a dead peer), and with a second lock
+in the picture an ABBA ordering deadlocks the loop outright.
+
+Two sub-rules:
+
+* an ``await`` of a network/queue primitive (aiohttp verbs,
+  ``resp.json()``/``.read()``/``.text()``, queue ``get``/``put``/
+  ``join``, ``asyncio.sleep``) lexically inside ``async with <lock>:``
+  is flagged at the await, suppressible at either the await line or the
+  ``async with`` header (one allow covers a deliberately-held block);
+* lock-acquisition ORDER is collected per function — including locks
+  acquired by same-module functions called while a lock is held — and
+  any A-then-B vs B-then-A pair across the file is flagged.
+
+A "lock" is any ``async with`` context whose name ends with ``lock``
+or ``mutex`` (``self._register_lock``, ``state_lock``, ...) — naming
+convention as lint contract, same spirit as the counter registry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from baton_tpu.analysis import _astutil as au
+from baton_tpu.analysis.engine import Checker, CheckContext, Finding, register
+
+# attribute names that mean "this await leaves the process" (HTTP verb,
+# body read, queue hand-off) — receiver-agnostic by design: sessions,
+# responses and queues go by many names
+NETWORK_ATTRS = {
+    "get", "post", "put", "patch", "delete", "head", "request",
+    "read", "text", "json", "recv", "receive", "send", "send_json",
+    "fetch", "connect", "join", "drain",
+}
+NETWORK_DOTTED = {"asyncio.sleep"}
+
+
+def _lock_name(expr: ast.AST, class_name: Optional[str]) -> Optional[str]:
+    """Normalized lock identity for an ``async with`` context expr, or
+    None when the context is not a lock. ``self._x_lock`` in two
+    methods of one class must compare equal -> ``Class._x_lock``."""
+    name = au.dotted_name(expr)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1].lower()
+    if not (leaf.endswith("lock") or leaf.endswith("mutex")):
+        return None
+    if name.startswith("self.") and class_name is not None:
+        return f"{class_name}.{name[len('self.'):]}"
+    return name
+
+
+def _is_network_call(call: ast.Call) -> bool:
+    dotted = au.call_name(call)
+    if dotted in NETWORK_DOTTED:
+        return True
+    return (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in NETWORK_ATTRS
+    )
+
+
+@register
+class LockDisciplineChecker(Checker):
+    rule = "BTL002"
+    title = "network await under an asyncio lock / lock-order conflict"
+
+    def check(self, ctx: CheckContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # func qualname -> [(lock, node)] locks it acquires at top level
+        acquires: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        # (held, acquired) -> first location witnessing that order
+        order: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        # (held_lock, lock_line, callee_qualname, call_node)
+        held_calls: List[Tuple[str, int, str, ast.AST]] = []
+
+        def visit_body(
+            stmts, qual: str, cls: Optional[str],
+            held: List[Tuple[str, int]],
+        ) -> None:
+            for stmt in stmts:
+                self._visit_node(
+                    stmt, qual, cls, held,
+                    findings, acquires, order, held_calls, ctx,
+                )
+
+        for qual, cls, node in au.iter_function_defs(ctx.tree):
+            acquires.setdefault(qual, [])
+            visit_body(node.body, qual, cls, [])
+
+        # interprocedural edges: calling f() while holding L orders L
+        # before every lock f acquires (one hop is what real code does;
+        # deeper chains would need whole-program analysis)
+        for held, lock_line, callee, call in held_calls:
+            for acquired, acq_node in acquires.get(callee, []):
+                if acquired != held:
+                    order.setdefault(
+                        (held, acquired),
+                        (call.lineno, call.col_offset),
+                    )
+
+        reported: Set[frozenset] = set()
+        for (a, b), (line, col) in sorted(order.items()):
+            if (b, a) in order and frozenset((a, b)) not in reported:
+                reported.add(frozenset((a, b)))
+                other_line, _ = order[(b, a)]
+                findings.append(
+                    Finding(
+                        self.rule, ctx.path, line, col,
+                        f"lock-order conflict: `{a}` is held while "
+                        f"acquiring `{b}` here, but line {other_line} "
+                        f"acquires them in the opposite order — an "
+                        f"ABBA deadlock on the event loop",
+                    )
+                )
+        return findings
+
+    def _visit_node(
+        self, node, qual, cls, held, findings, acquires, order,
+        held_calls, ctx,
+    ) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # separate execution context
+        if isinstance(node, ast.AsyncWith):
+            new_held = list(held)
+            for item in node.items:
+                expr = item.context_expr
+                lock = _lock_name(expr, cls)
+                if lock is not None:
+                    acquires[qual].append((lock, node))
+                    for outer, _line in new_held:
+                        if outer != lock:
+                            order.setdefault(
+                                (outer, lock),
+                                (node.lineno, node.col_offset),
+                            )
+                    new_held.append((lock, node.lineno))
+                elif (
+                    held
+                    and isinstance(expr, ast.Call)
+                    and _is_network_call(expr)
+                ):
+                    # async with session.get(...) under a lock is the
+                    # same hazard as awaiting it
+                    self._flag_network(expr, held, findings, ctx)
+            for child in ast.iter_child_nodes(node):
+                if child not in (
+                    [i.context_expr for i in node.items]
+                    + [i.optional_vars for i in node.items]
+                ):
+                    self._visit_node(
+                        child, qual, cls, new_held,
+                        findings, acquires, order, held_calls, ctx,
+                    )
+            return
+        if held and isinstance(node, ast.Await):
+            value = node.value
+            if isinstance(value, ast.Call) and _is_network_call(value):
+                self._flag_network(value, held, findings, ctx)
+        if held and isinstance(node, ast.Call):
+            callee = au.resolve_local_call(node, cls)
+            if callee is not None:
+                innermost, line = held[-1]
+                held_calls.append((innermost, line, callee, node))
+        for child in ast.iter_child_nodes(node):
+            self._visit_node(
+                child, qual, cls, held,
+                findings, acquires, order, held_calls, ctx,
+            )
+
+    def _flag_network(self, call, held, findings, ctx) -> None:
+        lock, lock_line = held[-1]
+        name = au.call_name(call) or f"<expr>.{call.func.attr}"
+        findings.append(
+            Finding(
+                self.rule, ctx.path, call.lineno, call.col_offset,
+                f"await of network/queue primitive `{name}` while "
+                f"holding lock `{lock}` (acquired line {lock_line}) "
+                f"stalls every waiter for a peer round-trip",
+                also_lines=(lock_line,),
+            )
+        )

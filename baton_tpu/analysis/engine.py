@@ -1,0 +1,360 @@
+"""batonlint engine — file walking, suppressions, registry, reporters.
+
+Deliberately dependency-free (stdlib ``ast`` only): the lint step must
+run in CI before any heavyweight install, and importing this module
+must never drag in jax/aiohttp. Checkers register themselves through
+:func:`register`; :mod:`baton_tpu.analysis.checkers` imports the five
+rule modules for their registration side effect.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Checker",
+    "CheckContext",
+    "Finding",
+    "Report",
+    "all_rules",
+    "register",
+    "run_paths",
+    "run_source",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``also_lines`` are additional lines where a ``# batonlint:
+    allow[RULE]`` comment suppresses this finding — e.g. a BTL002
+    await-under-lock finding is suppressible at the ``async with
+    <lock>:`` header as well as at the await itself, so one comment
+    covers a whole deliberately-held lock block.
+    """
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    also_lines: Tuple[int, ...] = ()
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclasses.dataclass
+class Report:
+    """Aggregate result of one lint run."""
+
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    suppressed: int = 0
+    files_checked: int = 0
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.errors
+
+
+_ALLOW_RE = re.compile(r"#\s*batonlint:\s*allow\[([^\]]*)\]")
+
+
+class Suppressions:
+    """Per-line ``# batonlint: allow[RULE1,RULE2]`` / ``allow[*]`` map."""
+
+    def __init__(self, source: str) -> None:
+        self._by_line: Dict[int, frozenset] = {}
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            m = _ALLOW_RE.search(text)
+            if m:
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                self._by_line[lineno] = rules
+
+    def allows(self, line: int, rule: str) -> bool:
+        rules = self._by_line.get(line)
+        return rules is not None and (rule in rules or "*" in rules)
+
+    def allows_finding(self, finding: Finding) -> bool:
+        return any(
+            self.allows(line, finding.rule)
+            for line in (finding.line, *finding.also_lines)
+        )
+
+
+class CheckContext:
+    """Everything a checker may need about the file under analysis."""
+
+    def __init__(
+        self,
+        path: str,
+        source: str,
+        tree: ast.Module,
+        counter_registry: Optional[Tuple[frozenset, tuple]] = None,
+    ) -> None:
+        self.path = path
+        self.posix_path = pathlib.PurePath(path).as_posix()
+        self.parts = pathlib.PurePath(path).parts
+        self.source = source
+        self.tree = tree
+        # BTL030: (declared_names, declared_prefixes), resolved by the
+        # runner from baton_tpu/utils/metrics.py or injected by tests.
+        self.counter_registry = counter_registry
+
+
+class Checker:
+    """Base class: subclasses set ``rule``/``title`` and implement
+    :meth:`check`; :meth:`applies_to` scopes the rule by path."""
+
+    rule: str = ""
+    title: str = ""
+
+    def applies_to(self, ctx: CheckContext) -> bool:
+        return True
+
+    def check(self, ctx: CheckContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Checker] = {}
+
+
+def register(checker_cls):
+    """Class decorator: instantiate and register a checker by rule id."""
+    inst = checker_cls()
+    if not inst.rule:
+        raise ValueError(f"{checker_cls.__name__} has no rule id")
+    if inst.rule in _REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.rule}")
+    _REGISTRY[inst.rule] = inst
+    return checker_cls
+
+
+def all_rules() -> Dict[str, str]:
+    """``{rule_id: one-line title}`` for every registered checker."""
+    _load_checkers()
+    return {rule: _REGISTRY[rule].title for rule in sorted(_REGISTRY)}
+
+
+def _load_checkers() -> None:
+    # import for the registration side effect; idempotent
+    from baton_tpu.analysis import checkers  # noqa: F401
+
+
+def _select(rules: Optional[Sequence[str]]) -> List[Checker]:
+    _load_checkers()
+    if rules is None:
+        return [_REGISTRY[r] for r in sorted(_REGISTRY)]
+    unknown = sorted(set(rules) - set(_REGISTRY))
+    if unknown:
+        raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+    return [_REGISTRY[r] for r in sorted(set(rules))]
+
+
+def run_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Sequence[str]] = None,
+    counter_registry: Optional[Tuple[frozenset, tuple]] = None,
+    report: Optional[Report] = None,
+) -> List[Finding]:
+    """Lint one source string (the unit-test entry point).
+
+    ``path`` scopes path-sensitive rules (BTL001/BTL030 only fire under
+    a ``server/`` directory), so fixtures pass paths like
+    ``"baton_tpu/server/x.py"``. Returns unsuppressed findings sorted
+    by location; suppressed counts land on ``report`` when given.
+    """
+    report = report if report is not None else Report()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        report.errors.append(f"{path}:{exc.lineno}: syntax error: {exc.msg}")
+        return []
+    ctx = CheckContext(path, source, tree, counter_registry=counter_registry)
+    suppressions = Suppressions(source)
+    findings: List[Finding] = []
+    seen = set()
+    for checker in _select(rules):
+        if not checker.applies_to(ctx):
+            continue
+        try:
+            raw = list(checker.check(ctx))
+        except Exception as exc:  # a buggy checker must not kill the run
+            report.errors.append(
+                f"{path}: checker {checker.rule} crashed: {exc!r}"
+            )
+            continue
+        for f in raw:
+            key = (f.rule, f.line, f.col, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            if suppressions.allows_finding(f):
+                report.suppressed += 1
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    report.findings.extend(findings)
+    report.files_checked += 1
+    return findings
+
+
+def iter_python_files(paths: Sequence[str]) -> List[pathlib.Path]:
+    """Expand files/directories into a sorted, deduplicated .py list."""
+    out = []
+    seen = set()
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            candidates = sorted(path.rglob("*.py"))
+        else:
+            candidates = [path]
+        for c in candidates:
+            if "__pycache__" in c.parts:
+                continue
+            key = str(c)
+            if key not in seen:
+                seen.add(key)
+                out.append(c)
+    return out
+
+
+def _resolve_counter_registry(
+    path: pathlib.Path,
+    cache: Dict[str, Optional[Tuple[frozenset, tuple]]],
+) -> Optional[Tuple[frozenset, tuple]]:
+    """Find the package's declared-counter registry for a checked file.
+
+    Walks the file's ancestors for a ``baton_tpu/utils/metrics.py``
+    (covering both in-repo paths and fixture trees) and parses its
+    ``DECLARED_COUNTERS`` / ``DECLARED_COUNTER_PREFIXES`` literals with
+    ``ast.literal_eval`` — no import, so linting never executes package
+    code. ``None`` (registry not found) disables BTL030 for the file.
+    """
+    for ancestor in [path.parent, *path.parent.parents]:
+        for candidate in (
+            ancestor / "baton_tpu" / "utils" / "metrics.py",
+            ancestor / "utils" / "metrics.py",
+        ):
+            key = str(candidate)
+            if key in cache:
+                if cache[key] is not None:
+                    return cache[key]
+                continue
+            if not candidate.is_file():
+                cache[key] = None
+                continue
+            cache[key] = _parse_counter_registry(candidate)
+            if cache[key] is not None:
+                return cache[key]
+    return None
+
+
+def _parse_counter_registry(
+    metrics_path: pathlib.Path,
+) -> Optional[Tuple[frozenset, tuple]]:
+    try:
+        tree = ast.parse(metrics_path.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return None
+    names: Optional[frozenset] = None
+    prefixes: tuple = ()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        value = node.value
+        # unwrap frozenset({...}) / tuple([...]) wrapper calls
+        if (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in ("frozenset", "tuple", "set")
+            and len(value.args) == 1
+        ):
+            value = value.args[0]
+        try:
+            literal = ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            continue
+        if target.id == "DECLARED_COUNTERS":
+            names = frozenset(str(x) for x in literal)
+        elif target.id == "DECLARED_COUNTER_PREFIXES":
+            prefixes = tuple(str(x) for x in literal)
+    if names is None:
+        return None
+    return names, prefixes
+
+
+def run_paths(
+    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+) -> Report:
+    """Lint files/directories; the CLI and test-suite entry point."""
+    report = Report()
+    registry_cache: Dict[str, Optional[Tuple[frozenset, tuple]]] = {}
+    files = iter_python_files(paths)
+    if not files:
+        report.errors.append(f"no Python files under: {', '.join(paths)}")
+        return report
+    for path in files:
+        try:
+            source = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as exc:
+            report.errors.append(f"{path}: unreadable: {exc}")
+            continue
+        run_source(
+            source,
+            path=str(path),
+            rules=rules,
+            counter_registry=_resolve_counter_registry(path, registry_cache),
+            report=report,
+        )
+    return report
+
+
+# -- reporters ---------------------------------------------------------
+def format_text(report: Report) -> str:
+    lines = [
+        f"{f.location()}: {f.rule} {f.message}" for f in report.findings
+    ]
+    for err in report.errors:
+        lines.append(f"error: {err}")
+    lines.append(
+        f"batonlint: {len(report.findings)} finding(s), "
+        f"{report.suppressed} suppressed, "
+        f"{report.files_checked} file(s) checked"
+    )
+    return "\n".join(lines)
+
+
+def format_json(report: Report) -> str:
+    return json.dumps(
+        {
+            "findings": [f.to_json() for f in report.findings],
+            "suppressed": report.suppressed,
+            "files_checked": report.files_checked,
+            "errors": list(report.errors),
+        },
+        indent=2,
+        sort_keys=True,
+    )

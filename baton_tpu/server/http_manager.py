@@ -100,6 +100,7 @@ from baton_tpu.server.utils import (
     bounded_gather,
     json_clean,
     read_body_capped,
+    read_json_capped,
 )
 from baton_tpu.utils.metrics import Metrics
 
@@ -166,6 +167,7 @@ class Experiment:
         min_cohort: int = 1,
         broadcast_quantize_bits: Optional[int] = None,
         broadcast_delta: Optional[str] = None,
+        delta_chain_depth: int = 2,
         fanout_concurrency: int = 64,
         journal_path: Optional[str] = None,
         journal_fsync: Any = "always",
@@ -206,6 +208,16 @@ class Experiment:
         automatically. Mutually exclusive with ``allow_pickle`` (push
         clients never pull) and ``broadcast_quantize_bits`` (the delta
         spec already carries the lossy-encoding budget).
+
+        ``delta_chain_depth``: how many consecutive rounds of delta
+        blobs to retain and advertise (``delta_broadcast`` mode). A
+        worker whose anchor is ``k < delta_chain_depth`` rounds old
+        reconstructs the current round through ``k`` small delta pulls
+        (each hop digest-verified) instead of one full-blob pull.
+        Depth 1 disables chaining (single-hop deltas only); the default
+        2 covers a worker that missed one round. Raising it trades blob
+        store bytes (one delta blob per retained hop) for cheaper
+        re-sync of longer absences.
 
         ``streaming_aggregation``: with the ``"mean"`` aggregator, fold
         each accepted upload into a running ``(weighted_sum, weight)``
@@ -297,10 +309,17 @@ class Experiment:
         self._blobs = BlobStore()
         self._prev_blob_sd: Optional[dict] = None
         self._prev_blob_digest: Optional[str] = None
-        # last round's delta blob descriptor {digest, size, from, to} —
-        # retained one extra round so a worker anchored two rounds back
-        # can chain anchor → N-1 → N instead of paying a full pull
-        self._prev_delta_hop: Optional[dict] = None
+        # consecutive recent delta-hop descriptors {digest, size, from,
+        # to}, oldest first, hop[i]["to"] == hop[i+1]["from"] — retained
+        # up to ``delta_chain_depth`` rounds so a worker anchored k
+        # rounds back (k < depth) chains k small delta pulls instead of
+        # paying a full pull
+        self._delta_hops: list = []
+        if delta_chain_depth < 1:
+            raise ValueError(
+                f"delta_chain_depth must be >= 1, got {delta_chain_depth}"
+            )
+        self.delta_chain_depth = int(delta_chain_depth)
         # streaming FedAvg accumulator for the round in flight (None for
         # robust/secure rounds, which need the buffered path)
         self._stream_acc = None
@@ -681,7 +700,14 @@ class Experiment:
 
     # -- membership ----------------------------------------------------
     async def handle_register(self, request: web.Request) -> web.Response:
-        data = await request.json()
+        try:
+            data = await read_json_capped(request)
+        except BodyTooLarge as exc:
+            self.metrics.inc("control_rejected_413")
+            return web.json_response(
+                {"err": "Body Too Large", "limit_bytes": exc.limit},
+                status=413,
+            )
         client = self.registry.register(
             remote=request.remote, port=data.get("port"), url=data.get("url")
         )
@@ -690,7 +716,14 @@ class Experiment:
         )
 
     async def handle_heartbeat(self, request: web.Request) -> web.Response:
-        data = await request.json()
+        try:
+            data = await read_json_capped(request)
+        except BodyTooLarge as exc:
+            self.metrics.inc("control_rejected_413")
+            return web.json_response(
+                {"err": "Body Too Large", "limit_bytes": exc.limit},
+                status=413,
+            )
         try:
             self.registry.heartbeat(data.get("client_id"), data.get("key"))
         except (UnknownClient, AuthError):
@@ -1412,8 +1445,9 @@ class Experiment:
     ) -> dict:
         """Encode the round's tensors ONCE into the blob store and build
         the v2 notify envelope. Retention keeps exactly this round's
-        full blob, its delta blob, and the previous full blob (a
-        straggler may still be mid-download when the round rolls)."""
+        full blob, the previous full blob (a straggler may still be
+        mid-download when the round rolls), and the last
+        ``delta_chain_depth`` rounds of delta blobs (the chain)."""
         full_blob = wire.encode(state_dict, {})
         full_digest = self._blobs.put(full_blob, kind="full")
         envelope: Dict[str, Any] = {
@@ -1425,8 +1459,7 @@ class Experiment:
         if encoding is not None:
             envelope["encoding"] = encoding
         keep = [full_digest, self._prev_blob_digest]
-        prev_hop = self._prev_delta_hop
-        hop = None
+        hops = self._delta_hops
         if delta_tensors is not None and full_digest != self._prev_blob_digest:
             delta_blob = wire.encode(delta_tensors, {})
             delta_digest = self._blobs.put(delta_blob, kind="delta")
@@ -1439,30 +1472,37 @@ class Experiment:
             envelope["delta"] = {
                 k: hop[k] for k in ("digest", "size", "from")
             }
-            keep.append(delta_digest)
-            # depth-2 delta chain: last round's delta blob still links
-            # into this round's anchor, so a worker anchored TWO rounds
-            # back (missed one round) chains anchor → N-1 → N through
-            # two small delta pulls instead of a full one. Each hop's
-            # reconstruction is digest-verified against its "to" — both
-            # hops are bit-defined the same way the depth-1 delta is.
-            if prev_hop is not None and prev_hop["to"] == hop["from"]:
-                envelope["delta_chain"] = [dict(prev_hop), dict(hop)]
-                keep.append(prev_hop["digest"])
-        elif (
+            # depth-N delta chain: the retained consecutive hops still
+            # link into this round's anchor, so a worker anchored k
+            # rounds back (k < delta_chain_depth) chains anchor → ... →
+            # N through k small delta pulls instead of a full one. Each
+            # hop's reconstruction is digest-verified against its "to"
+            # — every hop is bit-defined the same way the depth-1
+            # delta is. A discontinuity (recovery, an encoding round)
+            # restarts the chain at this hop.
+            if not (hops and hops[-1]["to"] == hop["from"]):
+                hops = []
+            hops = (hops + [hop])[-self.delta_chain_depth:]
+            if len(hops) >= 2:
+                envelope["delta_chain"] = [dict(h) for h in hops]
+        elif not (
             delta_tensors is None
             and full_digest == self._prev_blob_digest
-            and prev_hop is not None
-            and prev_hop["to"] == full_digest
+            and hops
+            and hops[-1]["to"] == full_digest
         ):
-            # params didn't move this round: last round's delta still
-            # ends at this round's blob, so a worker anchored two
-            # rounds back has a one-hop path — offer it directly
+            hops = []
+        else:
+            # params didn't move this round: the retained hops still
+            # end at this round's blob, so workers anchored up to
+            # delta_chain_depth rounds back keep their delta paths —
+            # offer the last hop directly and the chain unchanged
             envelope["delta"] = {
-                k: prev_hop[k] for k in ("digest", "size", "from")
+                k: hops[-1][k] for k in ("digest", "size", "from")
             }
-            keep.append(prev_hop["digest"])
-            hop = prev_hop  # the chain stays alive
+            if len(hops) >= 2:
+                envelope["delta_chain"] = [dict(h) for h in hops]
+        keep.extend(h["digest"] for h in hops)
         self._blobs.retain(keep)
         if encoding is None:
             # dense broadcasts anchor the next round's delta; quantized
@@ -1470,11 +1510,11 @@ class Experiment:
             # doesn't speak, and the stochastic seed changes per round)
             self._prev_blob_sd = state_dict
             self._prev_blob_digest = full_digest
-            self._prev_delta_hop = hop
+            self._delta_hops = hops
         else:
             self._prev_blob_sd = None
             self._prev_blob_digest = None
-            self._prev_delta_hop = None
+            self._delta_hops = []
         return envelope
 
     def _sample_cohort(self) -> list:

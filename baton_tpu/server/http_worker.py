@@ -63,7 +63,13 @@ from baton_tpu.core.training import LocalTrainer, make_local_trainer
 from baton_tpu.ops.padding import pad_dataset, round_up
 from baton_tpu.server import wire
 from baton_tpu.server.state import params_to_state_dict, state_dict_to_params
-from baton_tpu.server.utils import PeriodicTask, random_key
+from baton_tpu.server.utils import (
+    BodyTooLarge,
+    PeriodicTask,
+    random_key,
+    read_body_capped,
+    read_json_capped,
+)
 from baton_tpu.utils.metrics import Metrics
 
 GetData = Callable[[], Tuple[dict, int]]
@@ -132,6 +138,7 @@ class ExperimentWorker:
         outbox_backoff: Tuple[float, float] = (0.25, 10.0),
         outbox_dir: Optional[str] = None,
         upload_chunk_bytes: Optional[int] = None,
+        max_broadcast_bytes: Optional[int] = 1 << 30,
     ):
         """``compress`` turns on sparse round-delta uploads
         (ops/compression.py): ``"topk:0.05"`` keeps the top 5% of delta
@@ -155,7 +162,13 @@ class ExperimentWorker:
         committed-offset probe, so a transfer that dies at 90% resumes
         from the manager's committed prefix on the outbox's next
         attempt instead of re-sending the whole body. ``None`` (the
-        default) keeps the single-POST path for every size."""
+        default) keeps the single-POST path for every size.
+
+        ``max_broadcast_bytes``: cap on an inline ``round_start`` body
+        (the v1 push path; v2 pull rounds carry only a small envelope).
+        Oversized broadcasts get a 413 instead of an unbounded buffer.
+        ``None`` disables the cap. Default 1 GiB — far above any real
+        model push, low enough to bound a misbehaving peer."""
         self.name = name or getattr(model, "name", "fedmodel")
         self.model = model
         self.metrics = Metrics()
@@ -200,6 +213,12 @@ class ExperimentWorker:
                 f"got {upload_chunk_bytes}"
             )
         self.upload_chunk_bytes = upload_chunk_bytes
+        if max_broadcast_bytes is not None and max_broadcast_bytes < 1:
+            raise ValueError(
+                f"max_broadcast_bytes must be >= 1 or None, "
+                f"got {max_broadcast_bytes}"
+            )
+        self.max_broadcast_bytes = max_broadcast_bytes
         self._pending: Optional[_PendingUpdate] = self._load_persisted()
         if self._pending is not None:
             self.metrics.set_gauge("outbox_pending", 1)
@@ -262,7 +281,10 @@ class ExperimentWorker:
     async def register_with_manager(self) -> None:
         if self._register_lock.locked():
             return  # collision guard (reference ensure_no_collision, per-instance now)
-        async with self._register_lock:
+        # holding the lock across the retry loop IS the point: a second
+        # register attempt must wait out the whole handshake, not
+        # interleave with it
+        async with self._register_lock:  # batonlint: allow[BTL002]
             url = self.manager_url + "register"
             payload = {"url": self.worker_host, "port": self.port}
             backoff = 1.0
@@ -334,7 +356,14 @@ class ExperimentWorker:
             return web.json_response({"err": "Update in Progress"}, status=409)
         from baton_tpu.server import secure
 
-        data = await request.json()
+        try:
+            data = await read_json_capped(request)
+        except BodyTooLarge as exc:
+            self.metrics.inc("control_rejected_413")
+            return web.json_response(
+                {"err": "Body Too Large", "limit_bytes": exc.limit},
+                status=413,
+            )
         round_name = str(data["round"])
         # claim the round slot BEFORE the thread window (loop-atomic):
         # aborted rounds reuse names, so a stale delayed handler must be
@@ -383,7 +412,14 @@ class ExperimentWorker:
             return web.json_response({"err": "Wrong Client"}, status=404)
         from baton_tpu.server import secure
 
-        data = await request.json()
+        try:
+            data = await read_json_capped(request)
+        except BodyTooLarge as exc:
+            self.metrics.inc("control_rejected_413")
+            return web.json_response(
+                {"err": "Body Too Large", "limit_bytes": exc.limit},
+                status=413,
+            )
         round_name = str(data["round"])
         st = self._secure_state(round_name)
         if st is None:
@@ -468,7 +504,14 @@ class ExperimentWorker:
             return web.json_response({"err": "Wrong Client"}, status=404)
         from baton_tpu.server import secure
 
-        data = await request.json()
+        try:
+            data = await read_json_capped(request)
+        except BodyTooLarge as exc:
+            self.metrics.inc("control_rejected_413")
+            return web.json_response(
+                {"err": "Body Too Large", "limit_bytes": exc.limit},
+                status=413,
+            )
         round_name = str(data.get("round", ""))
         st = self._secure_state(round_name)
         if st is None or "cohort" not in st:
@@ -543,7 +586,16 @@ class ExperimentWorker:
     async def _handle_round_start_locked(
         self, request: web.Request
     ) -> web.Response:
-        body = await request.read()
+        try:
+            body = await read_body_capped(request, self.max_broadcast_bytes)
+        except BodyTooLarge as exc:
+            # mirror the manager's upload-cap contract: reject with the
+            # limit in the body so the peer can see what it tripped
+            self.metrics.inc("broadcast_rejected_413")
+            return web.json_response(
+                {"err": "Body Too Large", "limit_bytes": exc.limit},
+                status=413,
+            )
         if request.content_type == "application/json" or body[:1] == b"{":
             # v2 pull protocol: the notify body is a small JSON envelope;
             # the round payload is fetched from the manager's blob store
@@ -623,9 +675,10 @@ class ExperimentWorker:
         2. the envelope offers a delta FROM our anchor → fetch the small
            delta blob, reconstruct ``anchor + delta``, and verify the
            reconstruction re-encodes to the round blob's digest;
-        3. the envelope offers a delta CHAIN starting from our anchor (we
-           missed one round) → apply the hops in order, digest-verifying
-           each intermediate reconstruction;
+        3. the envelope offers a delta CHAIN passing through our anchor
+           (we missed up to ``delta_chain_depth - 1`` rounds) → apply
+           the hops from our anchor forward, digest-verifying each
+           intermediate reconstruction;
         4. otherwise (fresh worker, stale anchor, or verification
            failure) → fetch the full blob (Range-resumable).
         """
@@ -670,12 +723,26 @@ class ExperimentWorker:
             isinstance(delta_chain, list)
             and delta_chain
             and self._anchor_sd is not None
-            and isinstance(delta_chain[0], dict)
-            and delta_chain[0].get("from") == self._anchor_digest
         ):
-            cand = await self._apply_delta_chain(delta_chain, digest)
-            if cand is not None:
-                return cand
+            # the chain is the manager's recent-hop history (oldest
+            # first, up to delta_chain_depth hops): a worker absent k
+            # rounds joins at whichever hop starts FROM the anchor it
+            # still holds and applies the suffix from there
+            start = next(
+                (
+                    i
+                    for i, hop in enumerate(delta_chain)
+                    if isinstance(hop, dict)
+                    and hop.get("from") == self._anchor_digest
+                ),
+                None,
+            )
+            if start is not None:
+                cand = await self._apply_delta_chain(
+                    delta_chain[start:], digest
+                )
+                if cand is not None:
+                    return cand
         raw = await self._fetch_blob(digest, size)
         if raw is None:
             self.metrics.inc("blob_fetch_failed")
@@ -990,7 +1057,7 @@ class ExperimentWorker:
             )
         else:
             body = wire.encode(params_to_state_dict(self.params), meta)
-        self._enqueue_update(
+        await self._enqueue_update(
             _PendingUpdate(
                 round_name=round_name,
                 update_id=update_id,
@@ -1066,13 +1133,17 @@ class ExperimentWorker:
         except KeyError:
             return None
 
-    def _enqueue_update(self, pending: _PendingUpdate) -> None:
+    async def _enqueue_update(self, pending: _PendingUpdate) -> None:
         # one slot: a newer round's update supersedes anything still
-        # undelivered (the manager 410s stale rounds anyway)
+        # undelivered (the manager 410s stale rounds anyway).
+        # Slot mutation stays loop-atomic (before the first await); only
+        # the disk write goes to the thread pool — the outbox body is
+        # the full encoded update, large enough that a synchronous
+        # write_bytes would stall heartbeats (BTL001).
         if self._pending is not None:
             self._cancel_pending("superseded")
         self._pending = pending
-        self._persist_pending(pending)
+        await asyncio.to_thread(self._persist_pending, pending)
         self.metrics.set_gauge("outbox_pending", 1)
         if self._outbox_task is None or self._outbox_task.done():
             self._outbox_task = asyncio.ensure_future(self._drain_outbox())

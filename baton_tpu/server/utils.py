@@ -21,6 +21,7 @@ Replaces reference utils.py with deliberate fixes (SURVEY §2.9 decisions):
 from __future__ import annotations
 
 import asyncio
+import json
 import secrets
 import string
 from contextlib import suppress
@@ -115,7 +116,8 @@ async def read_body_capped(request, limit):
     Raises :class:`BodyTooLarge`; returns ``bytes`` otherwise.
     """
     if limit is None:
-        return await request.read()
+        # explicit opt-out: this IS the uncapped path callers chose
+        return await request.read()  # batonlint: allow[BTL020]
     limit = int(limit)
     declared = request.content_length
     if declared is not None and declared > limit:
@@ -126,6 +128,28 @@ async def read_body_capped(request, limit):
         if len(buf) > limit:
             raise BodyTooLarge(limit, len(buf))
     return bytes(buf)
+
+
+# Control-plane JSON (register, heartbeat, secure-agg key/share
+# exchange) is a few KiB in the worst case; 4 MiB is two orders of
+# magnitude of headroom while still bounding a hostile POST.
+MAX_JSON_BODY = 4 << 20
+
+
+async def read_json_capped(request, limit=MAX_JSON_BODY):
+    """Parse a JSON request body under a byte cap.
+
+    The ``await request.json()`` convenience buffers the whole body
+    before parsing — on control endpoints that is an unbounded
+    allocation driven by the peer. This reads through
+    :func:`read_body_capped` (Content-Length precheck + streamed
+    cut-off) and parses the result, so control handlers get the same
+    413 semantics as the upload path. Raises :class:`BodyTooLarge` on
+    oversize and ``json.JSONDecodeError``/``UnicodeDecodeError`` on a
+    malformed body (callers already answer 400 for those).
+    """
+    body = await read_body_capped(request, limit)
+    return json.loads(body.decode("utf-8"))
 
 
 class RunningMean:

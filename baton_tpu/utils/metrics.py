@@ -15,6 +15,71 @@ import time
 from contextlib import contextmanager
 from typing import Dict
 
+# ---------------------------------------------------------------------------
+# Declared counter registry.
+#
+# Dashboards and alert rules key on exact counter names, so every
+# counter incremented under baton_tpu/server/ must be declared here —
+# batonlint rule BTL030 enforces it (the linter parses these literals
+# with ast.literal_eval; keep them plain literals, no computed values).
+# Counter FAMILIES whose suffix is built at runtime (f-strings keyed on
+# an HTTP status, for example) declare their static prefix in
+# DECLARED_COUNTER_PREFIXES instead.
+DECLARED_COUNTERS = frozenset({
+    # manager: recovery / lifecycle
+    "recovery_rounds_aborted",
+    "recovery_rounds_resumed",
+    "clients_culled",
+    "rounds_finished",
+    "broadcast_timeout",
+    # manager: downlink data plane
+    "range_resumes",
+    "bytes_broadcast",
+    "blob_hits_delta",
+    "blob_hits_full",
+    # manager: uplink ingest / admission control
+    "ingest_rejected_429",
+    "uploads_rejected_413",
+    "control_rejected_413",
+    "bytes_uploaded",
+    "duplicate_updates_deduped",
+    "repeat_updates_ignored",
+    "updates_received",
+    "compressed_updates_received",
+    "chunk_bytes_received",
+    "chunked_uploads_assembled",
+    # manager: secure aggregation
+    "secure_rounds_aborted_keys",
+    "secure_rounds_aborted_shares",
+    "secure_rounds_unrecoverable",
+    "secure_dropouts_recovered",
+    # worker: outbox / delivery
+    "outbox_reloaded_from_disk",
+    "updates_delivered",
+    "update_retries",
+    "update_backpressure_429",
+    # worker: downlink blob fetch
+    "blob_reused_anchor",
+    "blob_fetch_delta",
+    "blob_fetch_delta_chain",
+    "blob_delta_digest_mismatch",
+    "blob_fetch_failed",
+    "blob_fetch_full",
+    "blob_range_resumes",
+    # worker: uplink chunked upload
+    "chunk_upload_resumes",
+    "chunk_bytes_resume_skipped",
+    "chunk_bytes_put",
+    # worker: control plane
+    "broadcast_rejected_413",
+    "train_epochs_completed",
+})
+
+DECLARED_COUNTER_PREFIXES = (
+    "updates_abandoned_",   # worker: f"updates_abandoned_{status}"
+    "broadcast_rejected_",  # manager: f"broadcast_rejected_{status}"
+)
+
 
 class _TimerStat:
     __slots__ = ("count", "total", "min", "max", "last")

@@ -1,0 +1,502 @@
+"""batonlint: known-bad fixtures flag, known-good fixtures pass,
+suppressions work, and — the lock — the repo itself is lint-clean.
+
+Fixtures are linted via :func:`run_source` with synthetic paths, so the
+path-scoped rules (BTL001/BTL020/BTL030 fire only under ``server/``)
+are exercised both inside and outside their scope.
+"""
+
+import pathlib
+import textwrap
+
+import pytest
+
+from baton_tpu.analysis import run_paths, run_source
+from baton_tpu.analysis.engine import Report, all_rules
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+SERVER_PATH = "baton_tpu/server/fixture.py"
+
+
+def lint(source, path=SERVER_PATH, rules=None, registry=None):
+    return run_source(
+        textwrap.dedent(source),
+        path=path,
+        rules=rules,
+        counter_registry=registry,
+    )
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ----------------------------------------------------------------------
+# BTL001 — blocking calls reachable from async def in server/
+
+
+def test_btl001_flags_direct_blocking_calls():
+    findings = lint(
+        """
+        import time, pickle, zlib, jax
+
+        async def handler(request):
+            time.sleep(1)
+            data = pickle.loads(b"x")
+            raw = zlib.decompress(data)
+            open("/tmp/f").read()
+            x.block_until_ready()
+            jax.device_get(x)
+        """,
+        rules=["BTL001"],
+    )
+    assert len(findings) == 6
+    assert set(rules_of(findings)) == {"BTL001"}
+
+
+def test_btl001_flags_transitive_helper_chain():
+    findings = lint(
+        """
+        class W:
+            def _persist(self, body):
+                self._path.write_bytes(body)
+
+            def _enqueue(self, body):
+                self._persist(body)
+
+            async def report(self, body):
+                self._enqueue(body)
+        """,
+        rules=["BTL001"],
+    )
+    assert rules_of(findings) == ["BTL001"]
+    assert "write_bytes" in findings[0].message
+    assert "via W._enqueue()" in findings[0].message
+
+
+def test_btl001_good_patterns_pass():
+    findings = lint(
+        """
+        import asyncio, time, pickle
+
+        def plain_sync_helper():
+            time.sleep(1)  # never called from an async def here
+
+        async def handler(request):
+            def work():
+                # closure handed off the loop: sanctioned routing
+                time.sleep(0.1)
+                return pickle.loads(b"x")
+            await asyncio.to_thread(work)
+            await asyncio.sleep(1)
+        """,
+        rules=["BTL001"],
+    )
+    assert findings == []
+
+
+def test_btl001_scoped_to_server_paths():
+    src = """
+    import time
+
+    async def f():
+        time.sleep(1)
+    """
+    assert lint(src, rules=["BTL001"]) != []
+    assert lint(src, path="baton_tpu/ops/fixture.py", rules=["BTL001"]) == []
+
+
+# ----------------------------------------------------------------------
+# BTL002 — awaits under locks, lock-order conflicts
+
+
+def test_btl002_flags_network_await_under_lock():
+    findings = lint(
+        """
+        class W:
+            async def register(self):
+                async with self._register_lock:
+                    async with self._session.get(url) as resp:
+                        data = await resp.json()
+        """,
+        rules=["BTL002"],
+    )
+    assert len(findings) == 2
+    assert all("_register_lock" in f.message for f in findings)
+    # every finding is also suppressible at the async-with header line
+    assert all(f.also_lines for f in findings)
+
+
+def test_btl002_flags_lock_order_conflict():
+    findings = lint(
+        """
+        class S:
+            async def a(self):
+                async with self._a_lock:
+                    async with self._b_lock:
+                        pass
+
+            async def b(self):
+                async with self._b_lock:
+                    async with self._a_lock:
+                        pass
+        """,
+        rules=["BTL002"],
+    )
+    assert len(findings) == 1
+    assert "lock-order conflict" in findings[0].message
+
+
+def test_btl002_interprocedural_lock_order():
+    findings = lint(
+        """
+        class S:
+            async def _locked_b(self):
+                async with self._b_lock:
+                    pass
+
+            async def a(self):
+                async with self._a_lock:
+                    self._locked_b()
+
+            async def b(self):
+                async with self._b_lock:
+                    async with self._a_lock:
+                        pass
+        """,
+        rules=["BTL002"],
+    )
+    assert len(findings) == 1
+    assert "lock-order conflict" in findings[0].message
+
+
+def test_btl002_good_patterns_pass():
+    findings = lint(
+        """
+        import asyncio
+
+        async def bounded(coros, sem):
+            async with sem:  # a semaphore window is not a lock
+                return await coros[0]
+
+        class S:
+            async def ok(self):
+                async with self._state_lock:
+                    self.counter += 1  # pure state mutation under lock
+                await self._session.get(url)  # network OUTSIDE the lock
+
+            async def nested_same(self):
+                async with self._a_lock:
+                    async with self._a_lock:
+                        pass  # re-entry is a bug, but not an ORDER bug
+        """,
+        rules=["BTL002"],
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# BTL010 — tracer hygiene in jit/shard_map functions
+
+
+def test_btl010_flags_host_ops_in_decorated_jit():
+    findings = lint(
+        """
+        import jax
+        import numpy as np
+        from functools import partial
+
+        STATS = {}
+
+        @jax.jit
+        def step(x):
+            print("tracing")
+            y = float(x)
+            STATS["calls"] = 1
+            return np.asarray(x) + y
+
+        @partial(jax.jit, static_argnums=0)
+        def step2(n, x):
+            return x.sum().item()
+        """,
+        path="baton_tpu/parallel/fixture.py",
+        rules=["BTL010"],
+    )
+    assert len(findings) == 5
+    messages = " ".join(f.message for f in findings)
+    for needle in ("print()", "float()", "module state", "np.asarray",
+                   ".item()"):
+        assert needle in messages
+
+
+def test_btl010_flags_callsite_traced_defs_and_lambdas():
+    findings = lint(
+        """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def outer(xs, mesh):
+            def kernel(x):
+                return x * int(x)
+            return shard_map(kernel, mesh=mesh)(xs)
+
+        probe = jax.jit(lambda x: float(x))
+        """,
+        path="baton_tpu/parallel/fixture.py",
+        rules=["BTL010"],
+    )
+    assert len(findings) == 2
+    assert {"int()" in f.message or "float()" in f.message
+            for f in findings} == {True}
+
+
+def test_btl010_good_patterns_pass():
+    findings = lint(
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            jax.debug.print("x={x}", x=x)
+            return jnp.asarray(x) * 2.0
+
+        def untraced(x):
+            # host code may do host things
+            print(float(x), np.asarray(x).item())
+            return x
+
+        def setup(config):
+            # np on NON-parameter host values inside a traced fn is fine
+            scale = np.asarray([1.0])
+
+            @jax.jit
+            def inner(v):
+                return v * jnp.asarray(scale)
+            return inner
+        """,
+        path="baton_tpu/parallel/fixture.py",
+        rules=["BTL010"],
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# BTL020 — uncapped request-body reads
+
+
+def test_btl020_flags_uncapped_reads():
+    findings = lint(
+        """
+        async def handle_upload(request):
+            body = await request.read()
+
+        async def handle_control(request):
+            data = await request.json()
+        """,
+        rules=["BTL020"],
+    )
+    assert len(findings) == 2
+    assert all("read_body_capped" in f.message for f in findings)
+
+
+def test_btl020_good_patterns_pass():
+    findings = lint(
+        """
+        from baton_tpu.server.utils import read_body_capped, read_json_capped
+
+        async def handle_upload(request):
+            body = await read_body_capped(request, 1 << 20)
+
+        async def handle_control(request):
+            data = await read_json_capped(request)
+
+        async def other_client_code(session):
+            # responses are not requests: reading them is not ingress
+            async with session.get(url) as resp:
+                return await resp.read()
+        """,
+        rules=["BTL020"],
+    )
+    assert findings == []
+
+
+def test_btl020_scoped_to_server_paths():
+    src = """
+    async def f(request):
+        return await request.read()
+    """
+    assert lint(src, rules=["BTL020"]) != []
+    assert lint(src, path="baton_tpu/core/fixture.py", rules=["BTL020"]) == []
+
+
+# ----------------------------------------------------------------------
+# BTL030 — counter registry
+
+
+REGISTRY = (frozenset({"updates_received"}), ("updates_abandoned_",))
+
+
+def test_btl030_flags_undeclared_and_typo():
+    findings = lint(
+        """
+        def f(m, status):
+            m.inc("updates_recieved")
+            m.inc(f"uploads_failed_{status}")
+        """,
+        rules=["BTL030"],
+        registry=REGISTRY,
+    )
+    assert len(findings) == 2
+    assert "updates_recieved" in findings[0].message
+
+
+def test_btl030_declared_names_prefixes_and_branches_pass():
+    findings = lint(
+        """
+        def f(m, status, kind):
+            m.inc("updates_received")
+            m.inc(f"updates_abandoned_{status}")
+            m.inc("updates_received" if kind else "updates_abandoned_410")
+            m.inc(name_from_variable)  # fully dynamic: not checkable
+        """,
+        rules=["BTL030"],
+        registry=REGISTRY,
+    )
+    assert findings == []
+
+
+def test_btl030_conditional_branch_typo_is_flagged():
+    findings = lint(
+        """
+        def f(m, kind):
+            m.inc("updates_received" if kind else "updates_recieved")
+        """,
+        rules=["BTL030"],
+        registry=REGISTRY,
+    )
+    assert len(findings) == 1
+
+
+def test_btl030_disabled_without_registry():
+    findings = lint(
+        """
+        def f(m):
+            m.inc("no_registry_no_check")
+        """,
+        rules=["BTL030"],
+        registry=None,
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# suppressions
+
+
+def test_suppression_at_finding_line():
+    report = Report()
+    findings = run_source(
+        textwrap.dedent(
+            """
+            async def f(request):
+                return await request.read()  # batonlint: allow[BTL020]
+            """
+        ),
+        path=SERVER_PATH,
+        rules=["BTL020"],
+        report=report,
+    )
+    assert findings == []
+    assert report.suppressed == 1
+
+
+def test_suppression_wildcard_and_wrong_rule():
+    src = """
+    async def f(request):
+        a = await request.read()  # batonlint: allow[*]
+        b = await request.read()  # batonlint: allow[BTL001]
+    """
+    findings = lint(src, rules=["BTL020"])
+    # the wildcard suppresses; the wrong rule id does not
+    assert len(findings) == 1
+    assert findings[0].line == 4
+
+
+def test_suppression_at_lock_header_covers_block():
+    report = Report()
+    findings = run_source(
+        textwrap.dedent(
+            """
+            class W:
+                async def register(self):
+                    async with self._register_lock:  # batonlint: allow[BTL002]
+                        await self._session.get(url)
+                        await self._session.post(url)
+            """
+        ),
+        path=SERVER_PATH,
+        rules=["BTL002"],
+        report=report,
+    )
+    assert findings == []
+    assert report.suppressed == 2
+
+
+# ----------------------------------------------------------------------
+# engine plumbing
+
+
+def test_all_rules_table():
+    table = all_rules()
+    assert set(table) == {"BTL001", "BTL002", "BTL010", "BTL020", "BTL030"}
+    assert all(table.values())
+
+
+def test_unknown_rule_is_an_error():
+    with pytest.raises(KeyError):
+        run_source("x = 1", rules=["BTL999"])
+
+
+def test_syntax_error_is_reported_not_raised():
+    report = Report()
+    findings = run_source("def broken(:", path="x.py", report=report)
+    assert findings == []
+    assert report.errors and "syntax error" in report.errors[0]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    from baton_tpu.analysis.__main__ import main
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    bad = tmp_path / "server" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "async def f(request):\n    return await request.read()\n"
+    )
+    assert main([str(good)]) == 0
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "BTL020" in out
+    assert main(["--format", "json", str(bad)]) == 1
+    assert '"rule": "BTL020"' in capsys.readouterr().out
+    assert main([str(tmp_path / "missing_dir")]) == 2
+
+
+# ----------------------------------------------------------------------
+# the lock: the repo's own tree must stay lint-clean
+
+
+def test_repo_is_lint_clean():
+    """Zero findings over baton_tpu/ — e.g. re-introducing an uncapped
+    ``await request.read()`` in server/http_worker.py fails this test
+    with a BTL020 finding naming the line."""
+    report = run_paths([str(REPO_ROOT / "baton_tpu")])
+    details = "\n".join(
+        f"{f.location()}: {f.rule} {f.message}" for f in report.findings
+    ) + "\n".join(report.errors)
+    assert report.clean, f"batonlint findings:\n{details}"
+    assert report.files_checked > 50

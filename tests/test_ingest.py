@@ -421,7 +421,7 @@ def test_outbox_honors_retry_after_floor(assert_counter):
             outbox_backoff=(0.01, 0.02),
         )
         w.client_id, w.key = "c", "k"
-        w._enqueue_update(_PendingUpdate(
+        await w._enqueue_update(_PendingUpdate(
             round_name="r", update_id="u", body=b"BTW1-ish",
         ))
         for _ in range(200):
@@ -650,7 +650,7 @@ def test_memoryerror_is_not_masked_as_client_400(monkeypatch):
 
 
 # ----------------------------------------------------------------------
-# depth-2 downlink delta chain
+# depth-N downlink delta chain
 
 
 def _rand_sd(rng, shape=(64, 8)):
@@ -767,12 +767,91 @@ def test_delta_chain_depth2_envelope_and_worker_reconstruction():
         assert snap["blob_fetch_full"] == 1
 
         # params unchanged this round: last round's delta still ends at
-        # this round's blob, offered directly as the depth-1 delta
+        # this round's blob, offered directly as the depth-1 delta —
+        # and the chain stays alive for workers anchored further back
         env4 = exp._publish_round_blobs("r4", 1, sd2, None, None)
         assert env4["blob"]["digest"] == d2
         assert env4["delta"]["digest"] == d12
         assert env4["delta"]["from"] == d1
-        assert "delta_chain" not in env4
+        assert [h["from"] for h in env4["delta_chain"]] == [d0, d1]
+
+    asyncio.run(main())
+
+
+def test_delta_chain_depth3_worker_absent_three_rounds():
+    """delta_chain_depth=3: a worker whose anchor is three rounds old
+    re-syncs through three small delta pulls, digest-verified per hop;
+    the default depth 2 would have forced it onto the full blob."""
+
+    async def main():
+        app = web.Application()
+        exp = Manager(app).register_experiment(
+            linear_regression_model(4), name="dc3",
+            start_background_tasks=False, delta_chain_depth=3,
+        )
+        rng = np.random.default_rng(7)
+        sds = [_rand_sd(rng)]
+        deltas = [None]
+        for _ in range(3):
+            sd, delta = _step(rng, sds[-1])
+            sds.append(sd)
+            deltas.append(delta)
+        digests = [blob_digest(wire.encode(sd, {})) for sd in sds]
+
+        envs = [
+            exp._publish_round_blobs(f"r{i + 1}", 1, sds[i], deltas[i], None)
+            for i in range(4)
+        ]
+        chain = envs[3]["delta_chain"]
+        assert [h["from"] for h in chain] == digests[:3]
+        assert [h["to"] for h in chain] == digests[1:]
+        # all three hop blobs survived retention
+        for h in chain:
+            assert h["digest"] in exp._blobs
+
+        blobs = {h["digest"]: exp._blobs.get(h["digest"])[0] for h in chain}
+        blobs[digests[3]] = exp._blobs.get(digests[3])[0]
+
+        # absent for rounds 2-4: anchor is round 1's blob
+        w, log = _stub_worker(blobs)
+        w._anchor_sd, w._anchor_digest = dict(sds[0]), digests[0]
+        got = await w._obtain_round_tensors(
+            digests[3], len(blobs[digests[3]]),
+            envs[3]["delta"], delta_chain=chain,
+        )
+        assert log == [h["digest"] for h in chain]
+        for k in sds[3]:
+            np.testing.assert_array_equal(got[k], sds[3][k])
+        snap = w.metrics.snapshot()["counters"]
+        assert snap["blob_fetch_delta_chain"] == 1
+        assert "blob_fetch_full" not in snap
+
+        # absent two rounds: joins the chain at its second hop
+        w, log = _stub_worker(blobs)
+        w._anchor_sd, w._anchor_digest = dict(sds[1]), digests[1]
+        got = await w._obtain_round_tensors(
+            digests[3], len(blobs[digests[3]]),
+            envs[3]["delta"], delta_chain=chain,
+        )
+        assert log == [h["digest"] for h in chain[1:]]
+        for k in sds[3]:
+            np.testing.assert_array_equal(got[k], sds[3][k])
+
+        # anchor older than the whole chain: full blob, no delta tries
+        w, log = _stub_worker(blobs)
+        w._anchor_sd = dict(sds[0])
+        w._anchor_digest = "0" * 64
+        got = await w._obtain_round_tensors(
+            digests[3], len(blobs[digests[3]]),
+            envs[3]["delta"], delta_chain=chain,
+        )
+        assert log == [digests[3]]
+        assert w.metrics.snapshot()["counters"]["blob_fetch_full"] == 1
+
+        # the next round trims the chain back to the newest 3 hops
+        sd4, delta34 = _step(rng, sds[3])
+        env5 = exp._publish_round_blobs("r5", 1, sd4, delta34, None)
+        assert [h["from"] for h in env5["delta_chain"]] == digests[1:]
 
     asyncio.run(main())
 
