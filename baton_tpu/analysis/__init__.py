@@ -12,17 +12,30 @@ checked any of that — ``http_worker.py`` regressed to an uncapped
 This package is the machine enforcement: a stdlib-``ast`` lint engine
 (:mod:`~baton_tpu.analysis.engine`) with a checker registry, per-line
 suppressions (``# batonlint: allow[RULE]``), text/JSON reporters, and a
-CLI (``python -m baton_tpu.analysis [paths]``). Rules:
+CLI (``python -m baton_tpu.analysis [paths]``).  Since the
+whole-program layer landed (:mod:`~baton_tpu.analysis.project` builds
+a cross-module symbol table, :mod:`~baton_tpu.analysis.callgraph` a
+static call graph over it), rules come in two scopes: per-file
+(``Checker``) and project-wide (``ProjectChecker`` — every file on the
+command line analyzed as one program).  Rules:
 
 =======  ==============================================================
 BTL001   blocking call (file I/O, ``time.sleep``, ``pickle.loads``,
          ``zlib.*``, ``.block_until_ready()``, ``jax.device_get``)
          reachable from an ``async def`` in ``baton_tpu/server/``
 BTL002   ``await`` of a network/queue primitive while holding an
-         asyncio lock; cross-function lock-acquisition-order conflicts
+         asyncio lock; lock-acquisition-order CYCLES over the
+         whole-program call graph (multi-hop, cross-module ABBA
+         pairs, both acquisition paths reported)  [project-wide]
+BTL003   shared-state snapshot (``self.reg.get(k)``, guarded
+         attribute, one-hop helper) used after an ``await`` /
+         ``to_thread`` boundary without an identity re-check — the
+         abort/restart TOCTOU that downgraded secure aggregation
 BTL010   tracer hygiene inside ``@jax.jit``/``shard_map`` functions
          (``print``, ``.item()``, ``float()``/``int()`` on traced
-         values, ``np.asarray``, module-state mutation)
+         values, ``np.asarray``, module-state mutation); traced
+         values followed by dataflow taint through assignments,
+         ``self.*`` writes, containers, and call results
 BTL020   raw ``request.read()`` / uncapped ``request.json()`` in an
          aiohttp handler (use ``utils.read_body_capped`` /
          ``utils.read_json_capped``)
@@ -32,7 +45,10 @@ BTL030   metrics counter used in ``server/`` but not declared in
 
 The repo itself must stay lint-clean: ``tests/test_analysis.py::
 test_repo_is_lint_clean`` runs this engine over ``baton_tpu/`` and
-asserts zero findings, and CI runs the CLI before the test suite.
+asserts zero findings, and CI runs the CLI before the test suite
+(uploading the ``--json-out`` report as a build artifact).
+``--changed-only`` lints the whole project but reports only files
+touched per ``git diff`` — the fast pre-commit mode.
 """
 
 from baton_tpu.analysis.engine import (  # noqa: F401
@@ -40,5 +56,6 @@ from baton_tpu.analysis.engine import (  # noqa: F401
     Report,
     all_rules,
     run_paths,
+    run_project_sources,
     run_source,
 )

@@ -7,6 +7,8 @@ fail the build on any finding while distinguishing broken invocations.
 from __future__ import annotations
 
 import argparse
+import pathlib
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -16,6 +18,38 @@ from baton_tpu.analysis.engine import (
     format_text,
     run_paths,
 )
+
+
+def _git_changed_files() -> Optional[List[str]]:
+    """Python files touched vs HEAD (staged + unstaged + untracked),
+    absolute paths; None when git is unavailable — the caller falls
+    back to a full lint rather than silently checking nothing."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30,
+        )
+        if top.returncode != 0:
+            return None
+        root = pathlib.Path(top.stdout.strip())
+        out: List[str] = []
+        for cmd in (
+            ["git", "diff", "--name-only", "HEAD", "--"],
+            ["git", "ls-files", "--others", "--exclude-standard"],
+        ):
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=30, cwd=root
+            )
+            if proc.returncode != 0:
+                return None
+            out.extend(
+                str(root / line)
+                for line in proc.stdout.splitlines()
+                if line.endswith(".py")
+            )
+        return sorted(set(out))
+    except (OSError, subprocess.SubprocessError):
+        return None
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -49,6 +83,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="print the rule table and exit",
     )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help=(
+            "report findings only in files changed per git (diff vs "
+            "HEAD + untracked); the whole project is still loaded so "
+            "cross-module rules stay sound"
+        ),
+    )
+    parser.add_argument(
+        "--json-out",
+        metavar="FILE",
+        help="additionally write the JSON report to FILE (CI artifact)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -56,11 +104,32 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{rule}  {title}")
         return 0
 
+    only_paths = None
+    if args.changed_only:
+        only_paths = _git_changed_files()
+        if only_paths is None:
+            print(
+                "batonlint: --changed-only: git unavailable, "
+                "linting everything",
+                file=sys.stderr,
+            )
+
     try:
-        report = run_paths(args.paths, rules=args.select)
+        report = run_paths(args.paths, rules=args.select,
+                           only_paths=only_paths)
     except KeyError as exc:
         print(f"batonlint: {exc.args[0]}", file=sys.stderr)
         return 2
+
+    if args.json_out:
+        try:
+            pathlib.Path(args.json_out).write_text(
+                format_json(report) + "\n", encoding="utf-8"
+            )
+        except OSError as exc:
+            print(f"batonlint: cannot write {args.json_out}: {exc}",
+                  file=sys.stderr)
+            return 2
 
     print(format_json(report) if args.format == "json" else format_text(report))
     if report.errors:

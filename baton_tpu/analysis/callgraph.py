@@ -1,0 +1,78 @@
+"""Static call graph over a batonlint :class:`~.project.Project`.
+
+Edges are the calls :meth:`Project.resolve_call` can pin down
+statically — same-module helpers, ``self.method``, imported symbols,
+and ``alias.func`` through an imported module.  Each edge keeps its
+call-site node so downstream rules (lock-order, staleness) can report
+the path a hazard travels, not just its endpoints.
+
+The graph is intentionally an over-approximation in neither direction:
+unresolvable calls (dynamic dispatch, HOFs, inheritance) are simply
+absent, so rules built on it UNDER-report across those boundaries and
+say so in their docs rather than guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from baton_tpu.analysis import _astutil as au
+from baton_tpu.analysis.project import FunctionInfo, Project
+
+__all__ = ["CallEdge", "CallGraph"]
+
+
+@dataclasses.dataclass
+class CallEdge:
+    caller: FunctionInfo
+    callee: FunctionInfo
+    node: ast.Call                # the call site, in caller's module
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+class CallGraph:
+    """``caller key -> [CallEdge]``; keys are ``module:Qual.name``."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.functions: Dict[str, FunctionInfo] = {
+            fn.key: fn for fn in project.functions()
+        }
+        self.edges: Dict[str, List[CallEdge]] = {}
+        for fn in project.functions():
+            out: List[CallEdge] = []
+            for node in au.walk_shallow(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = project.resolve_call(
+                    fn.module, fn.class_name, node
+                )
+                if callee is not None and callee.key != fn.key:
+                    out.append(CallEdge(fn, callee, node))
+            self.edges[fn.key] = out
+
+    def callees(self, key: str) -> List[CallEdge]:
+        return self.edges.get(key, [])
+
+    def walk_from(
+        self, key: str, max_depth: Optional[int] = None
+    ) -> Iterator[Tuple[Tuple[str, ...], CallEdge]]:
+        """DFS over call chains from ``key``; yields
+        ``(chain_of_caller_keys, edge)`` for every edge reachable without
+        revisiting a function already on the current chain (cycle-safe).
+        """
+        def rec(k: str, chain: Tuple[str, ...]) -> Iterator:
+            if max_depth is not None and len(chain) > max_depth:
+                return
+            for edge in self.edges.get(k, []):
+                if edge.callee.key in chain or edge.callee.key == key:
+                    continue
+                yield chain, edge
+                yield from rec(edge.callee.key, chain + (edge.callee.key,))
+
+        yield from rec(key, (key,))

@@ -1,4 +1,4 @@
-"""BTL002 — awaits under asyncio locks, and lock-order conflicts.
+"""BTL002 — awaits under asyncio locks, and lock-order cycles.
 
 Holding a state lock across a network/queue await is a liveness
 hazard: every other coroutine needing the lock stalls for a peer's
@@ -12,22 +12,33 @@ Two sub-rules:
   ``join``, ``asyncio.sleep``) lexically inside ``async with <lock>:``
   is flagged at the await, suppressible at either the await line or the
   ``async with`` header (one allow covers a deliberately-held block);
-* lock-acquisition ORDER is collected per function — including locks
-  acquired by same-module functions called while a lock is held — and
-  any A-then-B vs B-then-A pair across the file is flagged.
+* lock-acquisition ORDER is a whole-program directed graph: acquiring
+  B while holding A — directly, or anywhere down the static call graph
+  (:mod:`~baton_tpu.analysis.callgraph`), across modules — adds edge
+  A->B.  Any cycle in that graph is a potential deadlock and is
+  reported once with every acquisition path that closes it, so a
+  multi-hop cross-module ABBA pair shows both sides.
 
 A "lock" is any ``async with`` context whose name ends with ``lock``
 or ``mutex`` (``self._register_lock``, ``state_lock``, ...) — naming
 convention as lint contract, same spirit as the counter registry.
+Identities unify where references do: ``self._x_lock`` is
+``Class._x_lock`` from any method, a module-global is
+``pkg.mod.x_lock`` from its home module or through any import alias.
+Locks reached through other objects' attributes stay module-local
+(no type inference), so cycles through those are still unseen.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from baton_tpu.analysis import _astutil as au
-from baton_tpu.analysis.engine import Checker, CheckContext, Finding, register
+from baton_tpu.analysis.callgraph import CallGraph
+from baton_tpu.analysis.engine import Finding, ProjectChecker, register
+from baton_tpu.analysis.project import FunctionInfo, ModuleInfo, Project
 
 # attribute names that mean "this await leaves the process" (HTTP verb,
 # body read, queue hand-off) — receiver-agnostic by design: sessions,
@@ -40,19 +51,28 @@ NETWORK_ATTRS = {
 NETWORK_DOTTED = {"asyncio.sleep"}
 
 
-def _lock_name(expr: ast.AST, class_name: Optional[str]) -> Optional[str]:
-    """Normalized lock identity for an ``async with`` context expr, or
-    None when the context is not a lock. ``self._x_lock`` in two
-    methods of one class must compare equal -> ``Class._x_lock``."""
+def _lock_identity(
+    expr: ast.AST, class_name: Optional[str], mod: ModuleInfo
+) -> Optional[str]:
+    """Normalized project-wide lock identity for an ``async with``
+    context expr, or None when the context is not a lock."""
     name = au.dotted_name(expr)
     if name is None:
         return None
     leaf = name.rsplit(".", 1)[-1].lower()
     if not (leaf.endswith("lock") or leaf.endswith("mutex")):
         return None
-    if name.startswith("self.") and class_name is not None:
-        return f"{class_name}.{name[len('self.'):]}"
-    return name
+    root, _, rest = name.partition(".")
+    if root in ("self", "cls") and rest and class_name is not None:
+        return f"{class_name}.{rest}"
+    if rest:
+        target = mod.imports.get(root)
+        if target is not None:
+            # module-global lock referenced through an import alias:
+            # unify with its home-module bare name
+            return f"{target}.{rest}"
+        return f"{mod.name}:{name}"  # some other object's attribute
+    return f"{mod.name}.{name}"
 
 
 def _is_network_call(call: ast.Call) -> bool:
@@ -65,82 +85,213 @@ def _is_network_call(call: ast.Call) -> bool:
     )
 
 
+@dataclasses.dataclass
+class _Acquisition:
+    lock: str
+    node: ast.AST                     # the async with
+    held: Tuple[str, ...]             # locks already held at this site
+
+
+@dataclasses.dataclass
+class _Witness:
+    """One observed A-held-while-acquiring-B ordering."""
+
+    path: str
+    line: int
+    col: int
+    chain: Tuple[str, ...]            # function qualnames, caller first
+    also_line: Optional[int] = None   # acquisition header, for allows
+
+    def describe(self) -> str:
+        via = (
+            f" (via {' -> '.join(self.chain)})"
+            if len(self.chain) > 1 else ""
+        )
+        return f"{self.path}:{self.line}{via}"
+
+
 @register
-class LockDisciplineChecker(Checker):
+class LockDisciplineChecker(ProjectChecker):
     rule = "BTL002"
-    title = "network await under an asyncio lock / lock-order conflict"
+    title = "network await under an asyncio lock / lock-order cycle"
 
-    def check(self, ctx: CheckContext) -> Iterable[Finding]:
+    def check_project(self, project: Project) -> Iterable[Finding]:
         findings: List[Finding] = []
-        # func qualname -> [(lock, node)] locks it acquires at top level
-        acquires: Dict[str, List[Tuple[str, ast.AST]]] = {}
-        # (held, acquired) -> first location witnessing that order
-        order: Dict[Tuple[str, str], Tuple[int, int]] = {}
-        # (held_lock, lock_line, callee_qualname, call_node)
-        held_calls: List[Tuple[str, int, str, ast.AST]] = []
+        graph = CallGraph(project)
+        # per function: lock acquisitions and the calls made under lock
+        acquires: Dict[str, List[_Acquisition]] = {}
+        held_calls: Dict[str, List[Tuple[Tuple[str, ...], ast.Call]]] = {}
+        for fn in project.functions():
+            acqs: List[_Acquisition] = []
+            calls: List[Tuple[Tuple[str, ...], ast.Call]] = []
+            self._collect(
+                fn.node.body, fn, acqs, calls, (), findings
+            )
+            acquires[fn.key] = acqs
+            held_calls[fn.key] = calls
 
-        def visit_body(
-            stmts, qual: str, cls: Optional[str],
-            held: List[Tuple[str, int]],
-        ) -> None:
-            for stmt in stmts:
-                self._visit_node(
-                    stmt, qual, cls, held,
-                    findings, acquires, order, held_calls, ctx,
+        # locks each function may acquire transitively, with the call
+        # chain and site that witnesses the acquisition
+        trans_memo: Dict[str, Dict[str, Tuple[str, int, Tuple[str, ...]]]] = {}
+
+        def trans(key: str, visiting: frozenset) -> Dict[str, tuple]:
+            if key in trans_memo:
+                return trans_memo[key]
+            if key in visiting:
+                return {}  # recursion cycle: partial result is fine
+            fn = graph.functions[key]
+            out: Dict[str, tuple] = {}
+            for acq in acquires.get(key, []):
+                out.setdefault(
+                    acq.lock,
+                    (fn.module.path, acq.node.lineno, (fn.qualname,)),
                 )
+            for edge in graph.callees(key):
+                for lock, (p, l, chain) in trans(
+                    edge.callee.key, visiting | {key}
+                ).items():
+                    out.setdefault(lock, (p, l, (fn.qualname,) + chain))
+            trans_memo[key] = out
+            return out
 
-        for qual, cls, node in au.iter_function_defs(ctx.tree):
-            acquires.setdefault(qual, [])
-            visit_body(node.body, qual, cls, [])
-
-        # interprocedural edges: calling f() while holding L orders L
-        # before every lock f acquires (one hop is what real code does;
-        # deeper chains would need whole-program analysis)
-        for held, lock_line, callee, call in held_calls:
-            for acquired, acq_node in acquires.get(callee, []):
-                if acquired != held:
-                    order.setdefault(
-                        (held, acquired),
-                        (call.lineno, call.col_offset),
-                    )
-
-        reported: Set[frozenset] = set()
-        for (a, b), (line, col) in sorted(order.items()):
-            if (b, a) in order and frozenset((a, b)) not in reported:
-                reported.add(frozenset((a, b)))
-                other_line, _ = order[(b, a)]
-                findings.append(
-                    Finding(
-                        self.rule, ctx.path, line, col,
-                        f"lock-order conflict: `{a}` is held while "
-                        f"acquiring `{b}` here, but line {other_line} "
-                        f"acquires them in the opposite order — an "
-                        f"ABBA deadlock on the event loop",
-                    )
+        # the global lock-order graph: edge A -> B with first witness
+        order: Dict[Tuple[str, str], _Witness] = {}
+        for fn in project.functions():
+            for acq in acquires[fn.key]:
+                for outer in acq.held:
+                    if outer != acq.lock:
+                        order.setdefault(
+                            (outer, acq.lock),
+                            _Witness(
+                                fn.module.path, acq.node.lineno,
+                                acq.node.col_offset, (fn.qualname,),
+                            ),
+                        )
+            for held, call in held_calls[fn.key]:
+                callee = next(
+                    (e for e in graph.callees(fn.key) if e.node is call),
+                    None,
                 )
+                if callee is None:
+                    continue
+                for lock, (_p, _l, chain) in trans(
+                    callee.callee.key, frozenset({fn.key})
+                ).items():
+                    for outer in held:
+                        if outer != lock:
+                            order.setdefault(
+                                (outer, lock),
+                                _Witness(
+                                    fn.module.path, call.lineno,
+                                    call.col_offset,
+                                    (fn.qualname,) + chain,
+                                ),
+                            )
+
+        findings.extend(self._report_cycles(order))
         return findings
 
-    def _visit_node(
-        self, node, qual, cls, held, findings, acquires, order,
-        held_calls, ctx,
+    # -- lock-order cycle reporting ------------------------------------
+    def _report_cycles(
+        self, order: Dict[Tuple[str, str], _Witness]
+    ) -> List[Finding]:
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in order:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        for outs in adj.values():
+            outs.sort()
+
+        findings: List[Finding] = []
+        reported: set = set()
+        for start in sorted(adj):
+            cycle = self._shortest_cycle(start, adj)
+            if cycle is None:
+                continue
+            key = frozenset(cycle)
+            if key in reported:
+                continue
+            reported.add(key)
+            edges = list(zip(cycle, cycle[1:] + cycle[:1]))
+            witnesses = [order[e] for e in edges]
+            primary = witnesses[0]
+            path_desc = "; ".join(
+                f"`{a}` held while acquiring `{b}` at {w.describe()}"
+                for (a, b), w in zip(edges, witnesses)
+            )
+            ring = " -> ".join(f"`{x}`" for x in cycle + [cycle[0]])
+            also = tuple(
+                sorted(
+                    {
+                        line
+                        for w in witnesses
+                        for line in (w.line, w.also_line)
+                        if line is not None
+                        and w.path == primary.path
+                        and line != primary.line
+                    }
+                )
+            )
+            findings.append(
+                Finding(
+                    self.rule, primary.path, primary.line, primary.col,
+                    f"lock-order conflict: cycle {ring} — {path_desc} — "
+                    f"an ABBA deadlock on the event loop",
+                    also_lines=also,
+                )
+            )
+        return findings
+
+    @staticmethod
+    def _shortest_cycle(
+        start: str, adj: Dict[str, List[str]]
+    ) -> Optional[List[str]]:
+        """BFS for the shortest path start -> ... -> start; None when
+        ``start`` is on no cycle."""
+        frontier = [[start]]
+        seen = set()
+        while frontier:
+            nxt = []
+            for path in frontier:
+                for succ in adj.get(path[-1], []):
+                    if succ == start:
+                        return path
+                    if succ not in seen:
+                        seen.add(succ)
+                        nxt.append(path + [succ])
+            frontier = nxt
+        return None
+
+    # -- per-function collection ---------------------------------------
+    def _collect(
+        self,
+        stmts,
+        fn: FunctionInfo,
+        acqs: List[_Acquisition],
+        calls: List[Tuple[Tuple[str, ...], ast.Call]],
+        held: Tuple[str, ...],
+        findings: List[Finding],
+    ) -> None:
+        for stmt in stmts:
+            self._visit(stmt, fn, acqs, calls, held, findings)
+
+    def _visit(
+        self, node, fn, acqs, calls, held, findings
     ) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda)):
             return  # separate execution context
         if isinstance(node, ast.AsyncWith):
-            new_held = list(held)
+            new_held = held
+            header = [i.context_expr for i in node.items] + [
+                i.optional_vars for i in node.items
+            ]
             for item in node.items:
                 expr = item.context_expr
-                lock = _lock_name(expr, cls)
+                lock = _lock_identity(expr, fn.class_name, fn.module)
                 if lock is not None:
-                    acquires[qual].append((lock, node))
-                    for outer, _line in new_held:
-                        if outer != lock:
-                            order.setdefault(
-                                (outer, lock),
-                                (node.lineno, node.col_offset),
-                            )
-                    new_held.append((lock, node.lineno))
+                    acqs.append(_Acquisition(lock, node, new_held))
+                    new_held = new_held + (lock,)
                 elif (
                     held
                     and isinstance(expr, ast.Call)
@@ -148,41 +299,53 @@ class LockDisciplineChecker(Checker):
                 ):
                     # async with session.get(...) under a lock is the
                     # same hazard as awaiting it
-                    self._flag_network(expr, held, findings, ctx)
+                    self._flag_network(expr, held, node, fn, findings)
             for child in ast.iter_child_nodes(node):
-                if child not in (
-                    [i.context_expr for i in node.items]
-                    + [i.optional_vars for i in node.items]
-                ):
-                    self._visit_node(
-                        child, qual, cls, new_held,
-                        findings, acquires, order, held_calls, ctx,
-                    )
+                if child not in header:
+                    self._visit(child, fn, acqs, calls, new_held, findings)
             return
         if held and isinstance(node, ast.Await):
             value = node.value
             if isinstance(value, ast.Call) and _is_network_call(value):
-                self._flag_network(value, held, findings, ctx)
+                self._flag_network(value, held, None, fn, findings)
         if held and isinstance(node, ast.Call):
-            callee = au.resolve_local_call(node, cls)
-            if callee is not None:
-                innermost, line = held[-1]
-                held_calls.append((innermost, line, callee, node))
+            calls.append((held, node))
         for child in ast.iter_child_nodes(node):
-            self._visit_node(
-                child, qual, cls, held,
-                findings, acquires, order, held_calls, ctx,
-            )
+            self._visit(child, fn, acqs, calls, held, findings)
 
-    def _flag_network(self, call, held, findings, ctx) -> None:
-        lock, lock_line = held[-1]
+    def _flag_network(self, call, held, _hdr, fn, findings) -> None:
+        lock = held[-1]
         name = au.call_name(call) or f"<expr>.{call.func.attr}"
         findings.append(
             Finding(
-                self.rule, ctx.path, call.lineno, call.col_offset,
+                self.rule, fn.module.path, call.lineno, call.col_offset,
                 f"await of network/queue primitive `{name}` while "
-                f"holding lock `{lock}` (acquired line {lock_line}) "
-                f"stalls every waiter for a peer round-trip",
-                also_lines=(lock_line,),
+                f"holding lock `{lock}` stalls every waiter for a peer "
+                f"round-trip",
+                also_lines=self._enclosing_lock_lines(fn, call),
             )
         )
+
+    @staticmethod
+    def _enclosing_lock_lines(fn: FunctionInfo, call: ast.Call) -> tuple:
+        """Lines of the ``async with <lock>`` headers enclosing ``call``
+        — each is a valid suppression point for the await finding."""
+        lines = []
+
+        def rec(node, stack):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn.node:
+                return False
+            if node is call:
+                lines.extend(stack)
+                return True
+            new_stack = stack
+            if isinstance(node, ast.AsyncWith):
+                new_stack = stack + [node.lineno]
+            return any(
+                rec(child, new_stack)
+                for child in ast.iter_child_nodes(node)
+            )
+
+        rec(fn.node, [])
+        return tuple(lines)

@@ -21,12 +21,24 @@ A function counts as traced when it is decorated with
 ``partial(...)``), or when its name (or a lambda) is passed directly to
 such a transform at a call site in the same module —
 ``jax.jit(one_client)``, ``shard_map(kernel, mesh, ...)``.
+
+"Derived from the parameters" is intra-procedural dataflow taint, not
+just name matching: taint starts at the parameters and propagates
+through assignments (tuple unpacking included), ``self.*`` attribute
+writes, container element writes and mutator calls (``d["k"] = x``,
+``lst.append(x)`` taint the container), and call results (a call
+consuming a traced value returns a traced value — the conservative
+one-hop return rule), iterated to a fixpoint.  ``.shape``/``.dtype``/
+``.ndim`` reads are static under tracing and cut the taint, so
+``int(x.shape[0])`` stays legal.  ``self``/``cls`` themselves are NOT
+tainted (a jitted method marks them static via ``static_argnums``);
+only attributes explicitly written with traced values are.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional, Set
+from typing import Callable, Iterable, List, Optional, Set
 
 from baton_tpu.analysis import _astutil as au
 from baton_tpu.analysis.engine import Checker, CheckContext, Finding, register
@@ -37,6 +49,123 @@ _TRANSFORMS = {"jit", "pmap", "shard_map", "vmap_of_jit"}
 _NP_MATERIALIZERS = {"asarray", "array", "copy"}
 
 _CASTS = {"float", "int", "bool", "complex"}
+
+# attribute reads that are static (concrete) even on a tracer
+_STATIC_ATTRS = {"shape", "dtype", "ndim"}
+
+# container mutators whose tainted argument taints the receiver
+_CONTAINER_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _make_taint_oracle(tainted: Set[str]) -> Callable[[ast.AST], bool]:
+    """Predicate: does this expression produce a traced value, given
+    the current taint set (bare names and dotted ``self.attr`` paths)?"""
+
+    def expr_tainted(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return False
+            dotted = au.dotted_name(expr)
+            if dotted is not None and dotted in tainted:
+                return True
+            return expr_tainted(expr.value)
+        if isinstance(expr, _FUNC_NODES):
+            return False
+        if isinstance(expr, ast.Call):
+            if expr_tainted(expr.func):
+                return True
+            return any(expr_tainted(a) for a in expr.args) or any(
+                expr_tainted(k.value) for k in expr.keywords
+            )
+        return any(
+            expr_tainted(child)
+            for child in ast.iter_child_nodes(expr)
+            if isinstance(child, ast.expr)
+        )
+
+    return expr_tainted
+
+
+def _taint_target(target: ast.AST, add: Callable[[str], None]) -> None:
+    """Record an assignment target as tainted: names directly, dotted
+    ``self.x`` paths by path, container element writes by container."""
+    if isinstance(target, ast.Name):
+        add(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            _taint_target(elt, add)
+    elif isinstance(target, ast.Starred):
+        _taint_target(target.value, add)
+    elif isinstance(target, ast.Attribute):
+        dotted = au.dotted_name(target)
+        if dotted is not None:
+            add(dotted)
+        else:
+            _taint_target(target.value, add)
+    elif isinstance(target, ast.Subscript):
+        # d["k"] = tracer: reading ANY element of d may now yield it
+        _taint_target(target.value, add)
+
+
+def _propagate_taint(
+    body: list, tainted: Set[str], expr_tainted
+) -> bool:
+    """One propagation pass over every statement (nested defs included
+    — they trace as part of the same computation); True when the taint
+    set grew."""
+    changed = False
+
+    def add(name: Optional[str]) -> None:
+        nonlocal changed
+        if name and name not in tainted:
+            tainted.add(name)
+            changed = True
+
+    def call_args_tainted(call: ast.Call) -> bool:
+        return any(expr_tainted(a) for a in call.args) or any(
+            expr_tainted(k.value) for k in call.keywords
+        )
+
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                if expr_tainted(node.value):
+                    for t in node.targets:
+                        _taint_target(t, add)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                if node.value is not None and (
+                    expr_tainted(node.value)
+                    or (
+                        isinstance(node, ast.AugAssign)
+                        and expr_tainted(node.target)
+                    )
+                ):
+                    _taint_target(node.target, add)
+            elif isinstance(node, ast.NamedExpr):
+                if expr_tainted(node.value):
+                    _taint_target(node.target, add)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if expr_tainted(node.iter):
+                    _taint_target(node.target, add)
+            elif isinstance(node, ast.withitem):
+                if node.optional_vars is not None and expr_tainted(
+                    node.context_expr
+                ):
+                    _taint_target(node.optional_vars, add)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CONTAINER_MUTATORS
+                and call_args_tainted(node)
+            ):
+                _taint_target(node.func.value, add)
+    return changed
 
 
 def _transform_name(node: ast.AST) -> Optional[str]:
@@ -141,21 +270,27 @@ class TracerHygieneChecker(Checker):
 
         # everything derived from the traced function's parameters is a
         # tracer; nested defs inherit the outer params (they are traced
-        # as part of the same computation)
-        tracer_names = au.param_names(fn)
+        # as part of the same computation). self/cls are static under
+        # jit (static_argnums), so only attributes written with traced
+        # values taint — see _propagate_taint.
+        tainted = au.param_names(fn) - {"self", "cls"}
         body = fn.body if isinstance(fn.body, list) else [fn.body]
         for stmt in body:
             for node in ast.walk(stmt):
-                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    tracer_names |= au.param_names(node)
-                elif isinstance(node, ast.Lambda):
-                    tracer_names |= au.param_names(node)
+                if isinstance(node, _FUNC_NODES):
+                    tainted |= au.param_names(node) - {"self", "cls"}
 
-        def touches_tracer(expr: ast.AST) -> bool:
-            return any(
-                isinstance(n, ast.Name) and n.id in tracer_names
-                for n in ast.walk(expr)
-            )
+        # intra-procedural dataflow: propagate taint through plain
+        # assignments, tuple unpacking, `self.*` attributes, container
+        # element writes (which taint the container), and call results
+        # (any call consuming a traced value returns a traced value —
+        # the conservative one-hop return rule). Iterate to a fixpoint:
+        # `self._cache = x` early and `np.asarray(self._cache)` later
+        # converge regardless of AST walk order.
+        touches_tracer = _make_taint_oracle(tainted)
+        for _ in range(10):  # fixpoint cap; real bodies settle in 2-3
+            if not _propagate_taint(body, tainted, touches_tracer):
+                break
 
         for stmt in body:
             for node in ast.walk(stmt):

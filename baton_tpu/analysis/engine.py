@@ -20,10 +20,12 @@ __all__ = [
     "Checker",
     "CheckContext",
     "Finding",
+    "ProjectChecker",
     "Report",
     "all_rules",
     "register",
     "run_paths",
+    "run_project_sources",
     "run_source",
 ]
 
@@ -134,6 +136,20 @@ class Checker:
         raise NotImplementedError
 
 
+class ProjectChecker(Checker):
+    """Whole-program checker: runs once per lint run against the
+    :class:`~baton_tpu.analysis.project.Project` (every parsed file)
+    instead of once per file, and may emit findings in any of them.
+    Per-line suppressions still apply — each finding is matched against
+    the suppression map of the file it points into."""
+
+    def check(self, ctx: CheckContext) -> Iterable[Finding]:
+        return ()  # project checkers never run in the per-file pass
+
+    def check_project(self, project) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
 _REGISTRY: Dict[str, Checker] = {}
 
 
@@ -169,6 +185,111 @@ def _select(rules: Optional[Sequence[str]]) -> List[Checker]:
     return [_REGISTRY[r] for r in sorted(set(rules))]
 
 
+def _run_project(
+    project,
+    rules: Optional[Sequence[str]],
+    report: Report,
+    only_paths: Optional[frozenset] = None,
+) -> List[Finding]:
+    """Shared core: per-file checkers over each module, then project
+    checkers once over the whole :class:`Project`.  ``only_paths``
+    (already-normalized path strings) restricts which files run the
+    per-file pass and which files' findings are REPORTED — project
+    checkers still see every module, so cross-module reasoning stays
+    sound under ``--changed-only``."""
+    checkers = _select(rules)
+    suppressions = {m.path: Suppressions(m.source) for m in project.modules}
+    findings: List[Finding] = []
+    seen = set()
+
+    def wanted(path: str) -> bool:
+        return only_paths is None or _normalize_path(path) in only_paths
+
+    def admit(f: Finding) -> None:
+        key = (f.rule, f.path, f.line, f.col, f.message)
+        if key in seen:
+            return
+        seen.add(key)
+        if not wanted(f.path):
+            return
+        supp = suppressions.get(f.path)
+        if supp is not None and supp.allows_finding(f):
+            report.suppressed += 1
+        else:
+            findings.append(f)
+
+    for mod in project.modules:
+        report.files_checked += 1
+        if not wanted(mod.path):
+            continue
+        ctx = CheckContext(
+            mod.path, mod.source, mod.tree,
+            counter_registry=mod.counter_registry,
+        )
+        for checker in checkers:
+            if isinstance(checker, ProjectChecker):
+                continue
+            if not checker.applies_to(ctx):
+                continue
+            try:
+                raw = list(checker.check(ctx))
+            except Exception as exc:  # a buggy checker must not kill the run
+                report.errors.append(
+                    f"{mod.path}: checker {checker.rule} crashed: {exc!r}"
+                )
+                continue
+            for f in raw:
+                admit(f)
+    for checker in checkers:
+        if not isinstance(checker, ProjectChecker):
+            continue
+        try:
+            raw = list(checker.check_project(project))
+        except Exception as exc:
+            report.errors.append(
+                f"checker {checker.rule} crashed: {exc!r}"
+            )
+            continue
+        for f in raw:
+            admit(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    report.findings.extend(findings)
+    return findings
+
+
+def _normalize_path(path: str) -> str:
+    try:
+        return str(pathlib.Path(path).resolve())
+    except OSError:
+        return path
+
+
+def _parse_entries(
+    items, report: Report
+) -> list:
+    """``(path, source[, registry])`` -> parsed Project entries; syntax
+    errors land on the report, mirroring the old per-file behavior."""
+    entries = []
+    for item in items:
+        path, source = item[0], item[1]
+        registry = item[2] if len(item) > 2 else None
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as exc:
+            report.errors.append(
+                f"{path}:{exc.lineno}: syntax error: {exc.msg}"
+            )
+            continue
+        entries.append((path, source, tree, registry))
+    return entries
+
+
+def _build_project(entries):
+    from baton_tpu.analysis.project import Project
+
+    return Project.from_parsed(entries)
+
+
 def run_source(
     source: str,
     path: str = "<string>",
@@ -180,42 +301,31 @@ def run_source(
 
     ``path`` scopes path-sensitive rules (BTL001/BTL030 only fire under
     a ``server/`` directory), so fixtures pass paths like
-    ``"baton_tpu/server/x.py"``. Returns unsuppressed findings sorted
-    by location; suppressed counts land on ``report`` when given.
+    ``"baton_tpu/server/x.py"``. Project-scoped rules see a one-module
+    project. Returns unsuppressed findings sorted by location;
+    suppressed counts land on ``report`` when given.
     """
     report = report if report is not None else Report()
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as exc:
-        report.errors.append(f"{path}:{exc.lineno}: syntax error: {exc.msg}")
+    entries = _parse_entries([(path, source, counter_registry)], report)
+    if not entries:
         return []
-    ctx = CheckContext(path, source, tree, counter_registry=counter_registry)
-    suppressions = Suppressions(source)
-    findings: List[Finding] = []
-    seen = set()
-    for checker in _select(rules):
-        if not checker.applies_to(ctx):
-            continue
-        try:
-            raw = list(checker.check(ctx))
-        except Exception as exc:  # a buggy checker must not kill the run
-            report.errors.append(
-                f"{path}: checker {checker.rule} crashed: {exc!r}"
-            )
-            continue
-        for f in raw:
-            key = (f.rule, f.line, f.col, f.message)
-            if key in seen:
-                continue
-            seen.add(key)
-            if suppressions.allows_finding(f):
-                report.suppressed += 1
-            else:
-                findings.append(f)
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    report.findings.extend(findings)
-    report.files_checked += 1
-    return findings
+    return _run_project(_build_project(entries), rules, report)
+
+
+def run_project_sources(
+    files,
+    rules: Optional[Sequence[str]] = None,
+    report: Optional[Report] = None,
+) -> List[Finding]:
+    """Lint several in-memory modules as ONE project — the multi-module
+    fixture entry point (cross-module lock order, import resolution).
+    ``files`` is ``{path: source}`` or an iterable of ``(path, source)``;
+    module names derive from the paths (``fixtures/liba.py`` imports as
+    ``fixtures.liba``)."""
+    report = report if report is not None else Report()
+    items = files.items() if hasattr(files, "items") else list(files)
+    entries = _parse_entries(list(items), report)
+    return _run_project(_build_project(entries), rules, report)
 
 
 def iter_python_files(paths: Sequence[str]) -> List[pathlib.Path]:
@@ -307,28 +417,42 @@ def _parse_counter_registry(
 
 
 def run_paths(
-    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+    only_paths: Optional[Sequence[str]] = None,
 ) -> Report:
-    """Lint files/directories; the CLI and test-suite entry point."""
+    """Lint files/directories; the CLI and test-suite entry point.
+
+    All files are parsed into one :class:`Project` so project-scoped
+    checkers (cross-module lock order) see the whole program.
+    ``only_paths`` (the ``--changed-only`` filter) restricts the
+    per-file pass and the REPORTED findings to those files while the
+    project pass still reads everything.
+    """
     report = Report()
     registry_cache: Dict[str, Optional[Tuple[frozenset, tuple]]] = {}
     files = iter_python_files(paths)
     if not files:
         report.errors.append(f"no Python files under: {', '.join(paths)}")
         return report
+    items = []
     for path in files:
         try:
             source = path.read_text(encoding="utf-8")
         except (OSError, UnicodeDecodeError) as exc:
             report.errors.append(f"{path}: unreadable: {exc}")
             continue
-        run_source(
-            source,
-            path=str(path),
-            rules=rules,
-            counter_registry=_resolve_counter_registry(path, registry_cache),
-            report=report,
+        items.append(
+            (str(path), source,
+             _resolve_counter_registry(path, registry_cache))
         )
+    entries = _parse_entries(items, report)
+    only = (
+        frozenset(_normalize_path(p) for p in only_paths)
+        if only_paths is not None
+        else None
+    )
+    _run_project(_build_project(entries), rules, report, only_paths=only)
     return report
 
 

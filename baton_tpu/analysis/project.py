@@ -1,0 +1,211 @@
+"""Whole-program model for batonlint: modules, symbols, imports.
+
+The per-file checkers see one ``ast.Module`` at a time; everything a
+cross-module rule needs — "which function does ``secure.dh_shared_seed``
+resolve to", "which class owns ``_register_lock``" — lives here.  A
+:class:`Project` is built once per lint run from every file on the
+command line, so project-scoped checkers (``ProjectChecker`` in the
+engine) can follow calls across module boundaries.
+
+Resolution is deliberately syntactic (no imports are executed, same
+contract as the rest of batonlint):
+
+* module names come from the filesystem when the file exists (walking
+  up through ``__init__.py`` packages) and from the given path string
+  for in-memory fixtures, so ``baton_tpu/server/fixture.py`` is module
+  ``baton_tpu.server.fixture`` either way;
+* ``import a.b as x`` / ``from a.b import f`` bind local aliases to
+  dotted targets; relative imports resolve against the module's own
+  package;
+* a call resolves through (1) same-module functions/methods
+  (``self.helper`` -> ``Class.helper``), (2) an imported symbol, or
+  (3) ``alias.attr`` where the alias names a project module.  Dynamic
+  dispatch, inheritance, and re-exports are out of scope — a resolver
+  miss returns ``None`` and the caller degrades to per-file behavior.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from baton_tpu.analysis import _astutil as au
+
+__all__ = ["FunctionInfo", "ModuleInfo", "Project"]
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One def/async def, with enough context to name and revisit it."""
+
+    qualname: str                 # "Class.method" or bare name
+    class_name: Optional[str]
+    node: ast.AST                 # FunctionDef | AsyncFunctionDef
+    module: "ModuleInfo"
+
+    @property
+    def key(self) -> str:
+        """Project-unique id: ``module.dotted.name:Qual.name``."""
+        return f"{self.module.name}:{self.qualname}"
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+
+class ModuleInfo:
+    """One parsed source file plus its symbol table."""
+
+    def __init__(
+        self,
+        path: str,
+        source: str,
+        tree: ast.Module,
+        name: str,
+        counter_registry: Optional[Tuple[frozenset, tuple]] = None,
+    ) -> None:
+        self.path = path
+        self.posix_path = pathlib.PurePath(path).as_posix()
+        self.parts = pathlib.PurePath(path).parts
+        self.source = source
+        self.tree = tree
+        self.name = name
+        self.counter_registry = counter_registry
+        self.functions: Dict[str, FunctionInfo] = {}
+        for qual, cls, node in au.iter_function_defs(tree):
+            self.functions.setdefault(
+                qual, FunctionInfo(qual, cls, node, self)
+            )
+        self.imports = _collect_imports(tree, name)
+
+
+def _module_name_for(path: str) -> str:
+    """Dotted module name for ``path``.
+
+    Real files walk up while ``__init__.py`` siblings exist, so
+    ``/any/prefix/baton_tpu/server/x.py`` -> ``baton_tpu.server.x``.
+    Nonexistent (fixture) paths fall back to the path string itself:
+    ``fixtures/liba.py`` -> ``fixtures.liba``.
+    """
+    p = pathlib.Path(path)
+    stem_parts: List[str] = [] if p.stem == "__init__" else [p.stem]
+    if p.is_file():
+        parent = p.resolve().parent
+        parts = list(stem_parts)
+        while (parent / "__init__.py").is_file():
+            parts.insert(0, parent.name)
+            parent = parent.parent
+        return ".".join(parts) or p.stem
+    pure = pathlib.PurePath(path)
+    parts = [x for x in pure.parts[:-1] if x not in ("/", "\\", "..", ".")]
+    return ".".join(parts + stem_parts) or p.stem
+
+
+def _collect_imports(tree: ast.Module, module_name: str) -> Dict[str, str]:
+    """``{local alias: dotted target}`` for every import in the module
+    (function-level imports included — ``from . import secure`` inside a
+    handler binds the same way for resolution purposes)."""
+    imports: Dict[str, str] = {}
+    pkg_parts = module_name.split(".")[:-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    imports[alias.asname] = alias.name
+                else:
+                    root = alias.name.split(".")[0]
+                    imports.setdefault(root, root)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base_parts = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                base = ".".join(
+                    base_parts + ([node.module] if node.module else [])
+                )
+            else:
+                base = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                target = f"{base}.{alias.name}" if base else alias.name
+                imports[alias.asname or alias.name] = target
+    return imports
+
+
+class Project:
+    """All modules of one lint run, indexed by path and dotted name."""
+
+    def __init__(self) -> None:
+        self.modules: List[ModuleInfo] = []
+        self.by_path: Dict[str, ModuleInfo] = {}
+        self.by_name: Dict[str, ModuleInfo] = {}
+
+    @classmethod
+    def from_parsed(
+        cls,
+        entries: Iterable[Tuple[str, str, ast.Module,
+                                Optional[Tuple[frozenset, tuple]]]],
+    ) -> "Project":
+        """Build from ``(path, source, tree, counter_registry)`` tuples
+        (the engine parses; a file that failed to parse never gets
+        here)."""
+        project = cls()
+        for path, source, tree, registry in entries:
+            mod = ModuleInfo(path, source, tree, _module_name_for(path),
+                             counter_registry=registry)
+            project.modules.append(mod)
+            project.by_path[path] = mod
+            # first module wins on a name collision (e.g. two fixture
+            # trees shipping an identically-named module)
+            project.by_name.setdefault(mod.name, mod)
+        return project
+
+    def functions(self) -> Iterable[FunctionInfo]:
+        for mod in self.modules:
+            yield from mod.functions.values()
+
+    def function_by_dotted(self, dotted: str) -> Optional[FunctionInfo]:
+        """``baton_tpu.server.secure.dh_shared_seed`` -> FunctionInfo.
+
+        Tries the longest module prefix first so ``pkg.mod.Class.method``
+        resolves even when ``pkg.mod.Class`` isn't itself a module.
+        """
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = self.by_name.get(".".join(parts[:i]))
+            if mod is not None:
+                hit = mod.functions.get(".".join(parts[i:]))
+                if hit is not None:
+                    return hit
+        return None
+
+    def resolve_call(
+        self,
+        mod: ModuleInfo,
+        class_name: Optional[str],
+        call: ast.Call,
+    ) -> Optional[FunctionInfo]:
+        """Best-effort static resolution of a call expression made from
+        inside ``mod`` (``class_name`` = enclosing class, for ``self.``)."""
+        local = au.resolve_local_call(call, class_name)
+        if local is not None:
+            hit = mod.functions.get(local)
+            if hit is not None:
+                return hit
+            if "." not in local:
+                target = mod.imports.get(local)
+                if target is not None:
+                    return self.function_by_dotted(target)
+            return None
+        dotted = au.dotted_name(call.func)
+        if dotted is None or "." not in dotted:
+            return None
+        root, rest = dotted.split(".", 1)
+        target = self.imports_target(mod, root)
+        if target is None:
+            return None
+        return self.function_by_dotted(f"{target}.{rest}")
+
+    def imports_target(self, mod: ModuleInfo, alias: str) -> Optional[str]:
+        return mod.imports.get(alias)

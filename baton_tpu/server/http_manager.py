@@ -558,6 +558,9 @@ class Experiment:
             )
         finally:
             self._broadcasting = False
+            # the reporting window starts NOW: the broadcast itself must
+            # not count against the participants' round_timeout
+            self.rounds.restart_clock()
         if self.rounds.in_progress and not len(self.rounds):
             self.rounds.abort_round("resume broadcast unacknowledged")
             self.metrics.inc("recovery_rounds_aborted")
@@ -1219,6 +1222,10 @@ class Experiment:
             result = await self._start_round_phases(round_name, n_epoch)
         finally:
             self._broadcasting = False
+            # round setup (secure phases + notify fan-out) is the
+            # manager's own time; the expiry clock times the
+            # participants' reporting window, which opens here
+            self.rounds.restart_clock()
         # every participant may have reported during the (deferred)
         # broadcast window — settle the round now that the guard is down
         self._maybe_finish()

@@ -239,6 +239,11 @@ class ExperimentWorker:
         # one state dict per round_name, bounded to the two most recent
         # rounds so a long-lived worker doesn't accumulate key material.
         self._secure: dict = {}
+        # (round_name, state) captured at broadcast time; report_update
+        # masks with THIS object and refuses to upload if the live
+        # registry was re-keyed underneath it (abort/restart TOCTOU) —
+        # never silently falls back to an unmasked upload.
+        self._broadcast_secure_st: Optional[tuple] = None
 
         app.router.add_get(f"/{self.name}/metrics", self.handle_metrics)
         app.router.add_post(f"/{self.name}/round_start", self.handle_round_start)
@@ -354,6 +359,15 @@ class ExperimentWorker:
             # round's masks (aborted rounds REUSE round names — reference
             # naming parity). Refuse; the manager excludes us this round.
             return web.json_response({"err": "Update in Progress"}, status=409)
+        if self._broadcast_busy:
+            # a round_start broadcast is mid-acceptance: re-keying now
+            # would swap self._secure out from under its await windows
+            # (the BTL003 TOCTOU) and strand the broadcast on a dead
+            # state object. Refuse; a restarting manager retries keys
+            # once the broadcast window closes.
+            return web.json_response(
+                {"err": "Broadcast in Progress"}, status=409
+            )
         from baton_tpu.server import secure
 
         try:
@@ -765,7 +779,11 @@ class ExperimentWorker:
         None and the caller falls back to the full blob."""
         from baton_tpu.ops.compression import apply_delta_state_dict
 
-        sd = self._anchor_sd
+        # safe across the fetch awaits: each hop re-encodes and verifies
+        # against the hop's `to` digest, so a stale anchor cannot produce
+        # a wrong state — it fails verification and we fall back to the
+        # full blob.
+        sd = self._anchor_sd  # batonlint: allow[BTL003]
         to = None
         for i, hop in enumerate(hops):
             try:
@@ -858,45 +876,34 @@ class ExperimentWorker:
                 # this round: we cannot produce a correctly-masked
                 # upload, and an unmasked one would poison the sum
                 return web.json_response({"err": "No Round Keys"}, status=400)
-            from baton_tpu.server import secure as _secure
-
             mask_cohort = sorted(map(str, secure_info["cohort"]))
             if (
                 not set(mask_cohort) <= set(st["cohort"])
                 or self.client_id not in mask_cohort
             ):
                 return web.json_response({"err": "Bad Cohort"}, status=400)
+            opened = await asyncio.to_thread(
+                self._decrypt_share_inbox, st, round_name,
+                dict(secure_info.get("inbox", {})),
+            )
+            if self._secure.get(round_name) is not st:
+                # the round was re-keyed while the inbox decrypted in
+                # the thread pool (an abort/restart REUSES the name):
+                # committing mask_cohort into the dead state object
+                # would leave the live one bare and let report_update
+                # fall through to an UNMASKED upload — the secure-agg
+                # downgrade. Refuse the whole broadcast instead.
+                self.metrics.inc("broadcast_rejected_superseded")
+                return web.json_response({"err": "Superseded"}, status=409)
             st["mask_cohort"] = mask_cohort
             st["scale_bits"] = int(secure_info.get("scale_bits", 16))
-
-            # decrypt the share boxes relayed via the manager; a box
-            # failing authentication just leaves that sender's shares
-            # missing (reconstruction needs only t of n). O(C) modexps
-            # again — off the loop, same starvation argument as
-            # handle_secure_shares.
-            def _open_inbox():
-                opened = {}
-                for sender, ct_hex in dict(
-                        secure_info.get("inbox", {})).items():
-                    if sender == self.client_id or sender not in st["pks"]:
-                        continue
-                    try:
-                        key = _secure.dh_shared_seed(
-                            st["s_sk"], st["pks"][sender][1],
-                            f"{round_name}|shares|{sender}>{self.client_id}",
-                        )
-                        plain = _secure.unseal(
-                            key, bytes.fromhex(ct_hex)).decode()
-                        half = len(plain) // 2
-                        opened[sender] = (
-                            _secure.share_from_hex(plain[:half]),
-                            _secure.share_from_hex(plain[half:]),
-                        )
-                    except (ValueError, UnicodeDecodeError):
-                        pass
-                return opened
-
-            st["peer_shares"].update(await asyncio.to_thread(_open_inbox))
+            st["peer_shares"].update(opened)
+        # capture the secure state AT BROADCAST TIME: report_update
+        # must refuse (not downgrade to plain) if this exact object is
+        # no longer the round's live state when the upload is built
+        self._broadcast_secure_st = (
+            (round_name, st) if secure_info is not None else None
+        )
         self.params = new_params
         # the broadcast is this round's delta anchor: the manager holds
         # the identical tensors until end_round, so `anchor + delta`
@@ -917,6 +924,33 @@ class ExperimentWorker:
         self.round_in_progress = True
         asyncio.ensure_future(self._run_round(round_name, n_epoch))
         return web.json_response("OK")
+
+    def _decrypt_share_inbox(self, st, round_name: str, inbox: dict) -> dict:
+        """Decrypt the share boxes relayed via the manager (Bonawitz
+        round 2 inbox); a box failing authentication just leaves that
+        sender's shares missing (reconstruction needs only t of n).
+        O(C) modexps — call via ``asyncio.to_thread``, same starvation
+        argument as handle_secure_shares."""
+        from baton_tpu.server import secure as _secure
+
+        opened = {}
+        for sender, ct_hex in inbox.items():
+            if sender == self.client_id or sender not in st["pks"]:
+                continue
+            try:
+                key = _secure.dh_shared_seed(
+                    st["s_sk"], st["pks"][sender][1],
+                    f"{round_name}|shares|{sender}>{self.client_id}",
+                )
+                plain = _secure.unseal(key, bytes.fromhex(ct_hex)).decode()
+                half = len(plain) // 2
+                opened[sender] = (
+                    _secure.share_from_hex(plain[:half]),
+                    _secure.share_from_hex(plain[half:]),
+                )
+            except (ValueError, UnicodeDecodeError):
+                pass
+        return opened
 
     def _with_progress_hook(self, trainer: LocalTrainer) -> LocalTrainer:
         """Attach this worker's per-epoch metrics hook to ``trainer``.
@@ -994,9 +1028,23 @@ class ExperimentWorker:
             "loss_history": [float(x) for x in loss_history],
             "update_id": update_id,
         }
-        st = self._secure.get(round_name)
+        # use the secure state captured AT BROADCAST TIME, not a fresh
+        # registry fetch: if the round was re-keyed since (abort/restart
+        # reusing the name mid-round), a fresh fetch returns the NEW
+        # round's bare state, "mask_cohort" is absent, and the upload
+        # silently falls through to the PLAIN branch — defeating secure
+        # aggregation. Refuse instead; the manager treats us as a
+        # dropout and Shamir-recovers our masks.
+        captured = self._broadcast_secure_st
+        st = None
+        if captured is not None and captured[0] == round_name:
+            st = captured[1]
+            if self._secure.get(round_name) is not st or "mask_cohort" not in st:
+                self.metrics.inc("updates_refused_secure_downgrade")
+                self._broadcast_secure_st = None
+                return
         compressed_payload = None  # set only on the compressed branch
-        if st is not None and "mask_cohort" in st:
+        if st is not None:
             # Secure round: upload sample-weighted quantized params plus
             # every pairwise mask and the self mask PRG(b) — the manager
             # can only use the cohort sum (server/secure.py). Weighting
@@ -1187,16 +1235,20 @@ class ExperimentWorker:
             # 429 backpressure): keep the slot and back off
             p.attempts += 1
             self.metrics.inc("update_retries")
+            # backoff is computed from the slot snapshot BEFORE the
+            # re-register await below can yield: if this update is
+            # superseded while rejoining, the loop head re-checks slot
+            # identity rather than touching the stale object again
+            delay = min(base * (2 ** (p.attempts - 1)), cap)
+            delay *= 0.5 + random.random() / 2
+            if retry_after is not None:
+                delay = max(delay, retry_after)
             if status == 429:
                 self.metrics.inc("update_backpressure_429")
             if status == 401:
                 # manager restarted without its registry: rejoin, then
                 # retry the SAME update under the new credentials
                 await self.register_with_manager()
-            delay = min(base * (2 ** (p.attempts - 1)), cap)
-            delay *= 0.5 + random.random() / 2
-            if retry_after is not None:
-                delay = max(delay, retry_after)
             await asyncio.sleep(delay)
 
     @staticmethod

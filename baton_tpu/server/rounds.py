@@ -134,6 +134,19 @@ class RoundManager:
         self.started_at = self._clock()
         return self.round_name
 
+    def restart_clock(self) -> None:
+        """Restart the round-expiry clock at ``now``.
+
+        The straggler timeout is meant to bound the time a participant
+        takes to REPORT after being notified — not the manager's own
+        round setup. Callers invoke this as the broadcast guard drops,
+        so a slow (or fault-injected) broadcast/secure phase does not
+        eat into the participants' reporting window and expire a round
+        nobody had a fair chance to answer. No-op outside a round.
+        """
+        if self._in_progress:
+            self.started_at = self._clock()
+
     def client_start(self, client_id: str) -> None:
         if not self._in_progress:
             raise RoundNotInProgress

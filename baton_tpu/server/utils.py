@@ -224,7 +224,10 @@ class PeriodicTask:
                   f"tick failed: {exc!r}")
 
     async def _schedule(self) -> None:
-        stop = self._stop
+        # intentional identity capture: if the task is stopped and
+        # restarted, self._stop is replaced — THIS schedule must keep
+        # honoring its own generation's stop event, not the new one.
+        stop = self._stop  # batonlint: allow[BTL003]
         if self.run_immediately and not stop.is_set():
             await self._tick()
         while not stop.is_set():

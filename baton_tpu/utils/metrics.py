@@ -53,6 +53,8 @@ DECLARED_COUNTERS = frozenset({
     "secure_rounds_aborted_shares",
     "secure_rounds_unrecoverable",
     "secure_dropouts_recovered",
+    # worker: secure aggregation downgrade guard
+    "updates_refused_secure_downgrade",
     # worker: outbox / delivery
     "outbox_reloaded_from_disk",
     "updates_delivered",

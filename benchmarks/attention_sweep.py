@@ -99,6 +99,13 @@ def main():
     dev = jax.devices()[0]
     print(f"backend: {jax.default_backend()}")
     results = []
+    # artifact destination is resolved ONCE per run (promoting from the
+    # *_failed sibling to the real artifact at most once, never back):
+    # the old per-write resolve flipped to args.out on the first TPU
+    # success and clobbered the committed artifact with only the lengths
+    # measured so far in THIS run.
+    dest = None
+    prior_results = []
     for L in (int(x) for x in args.lens.split(",")):
         rec = {"L": L, "flash": {}}
         if L <= args.dense_max:
@@ -171,10 +178,41 @@ def main():
                       "measure": "fwd+bwd(q,k,v), mean of 10"},
             "results": results,
         }
-        dest = resolve_artifact_path(
-            args.out, _has_tpu_timing(payload), _has_tpu_timing)
-        with open(dest, "w") as f:
+        if dest != args.out:
+            new_dest = resolve_artifact_path(
+                args.out, _has_tpu_timing(payload), _has_tpu_timing)
+            if new_dest == args.out:
+                # promoted to the real artifact: carry the prior run's
+                # per-length records forward so lengths this run does
+                # not re-measure survive, and drop the *_failed sibling
+                # this run may have written before the promotion
+                try:
+                    with open(args.out) as f:
+                        prior = json.load(f)
+                    if _has_tpu_timing(prior):
+                        prior_results = [
+                            r for r in prior.get("results", ())
+                            if isinstance(r, dict)
+                            and isinstance(r.get("L"), int)
+                        ]
+                except (OSError, ValueError, AttributeError):
+                    prior_results = []
+                if dest is not None and os.path.exists(dest):
+                    try:
+                        os.unlink(dest)
+                    except OSError:
+                        pass
+            dest = new_dest
+        merged = {r["L"]: r for r in prior_results}
+        for r in results:
+            merged[r["L"]] = r
+        payload["results"] = [merged[k] for k in sorted(merged)]
+        # temp-file + atomic replace: a mid-dump death (tunnel reset,
+        # OOM-kill) must not leave a truncated artifact behind
+        tmp = f"{dest}.tmp"
+        with open(tmp, "w") as f:
             json.dump(payload, f, indent=2)
+        os.replace(tmp, dest)
 
 
 if __name__ == "__main__":

@@ -126,6 +126,31 @@ def test_transient_classifier_defers_to_shared_oom_rule(bench):
     assert not bench.is_transient_tunnel_error(e)
 
 
+def test_recorded_wave1024_last_record_wins(bench, tmp_path, monkeypatch):
+    """The headline wave1024 evidence follows the same precedence as
+    every other recorded series: the NEWEST TPU record wins, even when
+    it is slower — a legitimate remeasure must supersede a stale faster
+    headline instead of hiding behind a max-across-files."""
+    import json
+
+    jl = tmp_path / "benchmarks" / "r4_tpu_results.jsonl"
+    jl.parent.mkdir()
+    rows = [
+        {"stage": "wave1024", "platform": "tpu", "clients": 1024,
+         "wave_size": 256, "rounds_per_sec": 9.0},
+        # CPU smoke numbers are never trusted, however fast
+        {"stage": "wave1024", "platform": "cpu", "clients": 1024,
+         "wave_size": 256, "rounds_per_sec": 99.0},
+        {"stage": "wave1024", "platform": "tpu", "clients": 1024,
+         "wave_size": 128, "rounds_per_sec": 4.5},
+    ]
+    jl.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    rec = bench._recorded_wave1024()
+    assert rec["rounds_per_sec"] == 4.5
+    assert rec["wave_size"] == 128
+
+
 def test_recorded_conv_winner_trusts_only_tpu_records(bench, tmp_path,
                                                       monkeypatch):
     """The headline bench auto-adopts the conv-shootout winner — but

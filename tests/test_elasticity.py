@@ -31,8 +31,11 @@ def run(coro):
     return asyncio.run(coro)
 
 
-async def _federation(inj, n_workers=2, round_timeout=1.5, heartbeat_time=0.5):
-    """Manager (with fault middleware) + N workers over real sockets."""
+async def _federation(inj, n_workers=2, round_timeout=1.5, heartbeat_time=0.5,
+                      inject_workers=False):
+    """Manager (with fault middleware) + N workers over real sockets.
+    ``inject_workers`` adds the same middleware to the worker apps so a
+    test can fault the DOWNLINK (e.g. delay a round_start broadcast)."""
     model = linear_regression_model(10)
     nprng = np.random.default_rng(0)
     mport = free_port()
@@ -49,7 +52,9 @@ async def _federation(inj, n_workers=2, round_timeout=1.5, heartbeat_time=0.5):
     for _ in range(n_workers):
         wport = free_port()
         data = linear_client_data(nprng, min_batches=2, max_batches=3)
-        wapp = web.Application()
+        wapp = web.Application(
+            middlewares=[inj.middleware] if inject_workers else []
+        )
         worker = ExperimentWorker(
             wapp,
             model,
@@ -138,6 +143,44 @@ def test_dropped_update_straggler_watchdog_partial_aggregation():
         exp.rounds.round_timeout = 60.0
         await _drive_round(exp, mport, n_epoch=2)
         assert exp.metrics.snapshot()["counters"]["updates_received"] == 5
+
+        for r in runners:
+            await r.cleanup()
+
+    run(main())
+
+
+def test_slow_broadcast_does_not_eat_reporting_window():
+    """A broadcast slower than the whole round_timeout must not expire
+    the round before anyone can report: the manager restarts the
+    expiry clock as its broadcast guard drops, so the straggler window
+    times the REPORTING phase, not the manager's own fan-out. Pre-fix,
+    the watchdog's first tick after the fan-out returned force-ended
+    the round partial (elapsed already exceeded the timeout)."""
+    async def main():
+        inj = FaultInjector()
+        exp, workers, runners, mport = await _federation(
+            inj, round_timeout=60.0, inject_workers=True
+        )
+
+        # warm-up round, no faults: compiles both trainers so the fault
+        # round's timing is the injected delay, not first-call XLA
+        await _drive_round(exp, mport, n_epoch=2)
+        assert exp.metrics.snapshot()["counters"]["updates_received"] == 2
+
+        # one worker's /round_start notify now takes LONGER than the
+        # whole round_timeout ("round_start" only matches the worker
+        # route; the manager's own trigger is "start_round")
+        exp.rounds.round_timeout = 1.5
+        rule = inj.delay("round_start", seconds=2.0, times=1)
+        await _drive_round(exp, mport, n_epoch=2)
+        assert rule.hits == 1
+        snap = exp.metrics.snapshot()["counters"]
+        # BOTH updates landed: the reporting window opened after the
+        # slow fan-out instead of being pre-consumed by it
+        assert snap["updates_received"] == 4
+        assert snap["rounds_finished"] == 2
+        assert snap.get("broadcast_timeout", 0) == 0
 
         for r in runners:
             await r.cleanup()

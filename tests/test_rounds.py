@@ -99,6 +99,31 @@ def test_round_timeout_expiry():
     assert not rm.is_expired  # no round running
 
 
+def test_restart_clock_resets_expiry_window():
+    """A slow broadcast/secure phase must not eat the participants'
+    reporting window: the manager restarts the expiry clock as its
+    broadcast guard drops."""
+    clock = FakeClock()
+    rm = RoundManager("exp", round_timeout=10.0, clock=clock)
+    rm.start_round(n_epoch=1)
+    rm.client_start("slow")
+    clock.t = 9.0  # round setup took almost the whole timeout
+    rm.restart_clock()
+    clock.t = 18.0  # 9 s into the REPORTING window: still healthy
+    assert not rm.is_expired
+    assert rm.elapsed == pytest.approx(9.0)
+    clock.t = 19.5  # now the reporting window itself has lapsed
+    assert rm.is_expired
+
+
+def test_restart_clock_noop_outside_round():
+    clock = FakeClock()
+    rm = RoundManager("exp", round_timeout=10.0, clock=clock)
+    rm.restart_clock()  # must not raise or invent a started_at
+    assert rm.started_at is None
+    assert not rm.is_expired
+
+
 def test_no_timeout_never_expires():
     clock = FakeClock()
     rm = RoundManager("exp", clock=clock)

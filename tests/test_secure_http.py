@@ -657,6 +657,141 @@ def test_secure_round_64_cohort_scaling():
     run(main())
 
 
+def test_midbroadcast_rekey_cannot_downgrade_to_plain_upload():
+    """The secure-aggregation downgrade TOCTOU, closed end-to-end.
+
+    A worker's broadcast acceptance snapshots ``self._secure[round]``
+    and then decrypts its share inbox in the thread pool. If the round
+    is re-keyed during that window (aborted rounds REUSE names), the
+    pre-fix worker committed the mask cohort into the DEAD state object
+    and ``report_update``'s fresh registry fetch found no
+    ``mask_cohort`` — silently uploading PLAIN weighted params. Now:
+    (1) a ``secure_keys`` arriving mid-broadcast is refused outright,
+    (2) a re-key that slips in anyway makes the worker refuse the whole
+    broadcast by state identity, and (3) the round still finalizes via
+    Shamir dropout recovery with every observed upload masked."""
+    import threading
+
+    async def main():
+        import aiohttp
+
+        exp, workers, runners, mport = await _secure_federation(3)
+        w0 = workers[0]
+
+        entered = threading.Event()
+        release = threading.Event()
+        orig_open = w0._decrypt_share_inbox
+
+        def gated(st, round_name, inbox):
+            entered.set()
+            assert release.wait(timeout=30.0), "test never released thread"
+            return orig_open(st, round_name, inbox)
+
+        w0._decrypt_share_inbox = gated
+
+        # record every upload the server's round state ever holds
+        seen = []
+        orig_end = exp.rounds.client_end
+
+        def spy(cid, resp):
+            seen.append((cid, resp))
+            orig_end(cid, resp)
+
+        exp.rounds.client_end = spy
+
+        async with aiohttp.ClientSession() as session:
+
+            async def _start():
+                async with session.get(
+                    f"http://127.0.0.1:{mport}/securetest/start_round"
+                    "?n_epoch=2"
+                ) as resp:
+                    return resp.status
+
+            start_task = asyncio.create_task(_start())
+            for _ in range(600):
+                if entered.is_set():
+                    break
+                await asyncio.sleep(0.05)
+            assert entered.is_set(), "broadcast never reached the inbox"
+            round_name = exp.rounds.round_name
+
+            # (1) mid-broadcast key rotation is refused, not honored
+            async with session.post(
+                f"http://127.0.0.1:{w0.port}/{w0.name}/secure_keys"
+                f"?client_id={w0.client_id}&key={w0.key}",
+                json={"round": round_name},
+            ) as resp:
+                assert resp.status == 409
+                assert "Broadcast" in (await resp.json())["err"]
+
+            # (2) simulate the race the refusal above cannot fully
+            # prevent (an abort + same-name restart re-keying between
+            # handlers): swap the live state object under the blocked
+            # broadcast, then let it proceed
+            assert w0._secure[round_name] is not None
+            w0._secure[round_name] = dict(w0._secure[round_name])
+            release.set()
+
+            assert await start_task == 200
+            for _ in range(600):
+                if not exp.rounds.in_progress:
+                    break
+                await asyncio.sleep(0.05)
+            assert not exp.rounds.in_progress
+
+        # the worker detected the superseded state and refused the
+        # whole broadcast instead of joining with dead keys
+        wsnap = w0.metrics.snapshot()["counters"]
+        assert wsnap.get("broadcast_rejected_superseded", 0) == 1
+        assert not w0.round_in_progress
+
+        # (3) the round finalized WITHOUT w0: its masks were Shamir-
+        # recovered, and nothing unmasked ever crossed the wire
+        snap = exp.metrics.snapshot()["counters"]
+        assert snap.get("rounds_finished", 0) == 1
+        assert snap.get("secure_dropouts_recovered", 0) >= 1
+        assert len(seen) == 2
+        assert all(cid != w0.client_id for cid, _ in seen)
+        for _cid, resp in seen:
+            assert resp["masked"]
+            for arr in resp["state_dict"].values():
+                assert np.asarray(arr).dtype == np.uint64
+
+        for r in runners:
+            await r.cleanup()
+
+    run(main())
+
+
+def test_report_update_refuses_secure_downgrade_directly():
+    """Unit-level guard on the upload path itself: if the broadcast-time
+    secure state is no longer the round's live state when the update is
+    built, ``report_update`` refuses — it must never fall through to
+    the plain (unmasked) encoding branch."""
+
+    async def main():
+        exp, workers, runners, mport = await _secure_federation(1)
+        w = workers[0]
+
+        live = {"mask_cohort": ["a"], "cohort": ["a"]}
+        w._broadcast_secure_st = ("update_securetest_00007", live)
+        # the registry was re-keyed behind the broadcast's back
+        w._secure["update_securetest_00007"] = dict(live)
+
+        await w.report_update("update_securetest_00007", 5, [0.1])
+
+        counters = w.metrics.snapshot()["counters"]
+        assert counters.get("updates_refused_secure_downgrade", 0) == 1
+        assert w._pending is None  # nothing was parked for delivery
+        assert w._broadcast_secure_st is None  # the dead capture is gone
+
+        for r in runners:
+            await r.cleanup()
+
+    run(main())
+
+
 def test_stale_secure_finalization_never_touches_replacement_round():
     """A finalization can lose its round while blocked in the
     reconstruction worker thread (realistic path: mass cull -> abort ->
