@@ -12,6 +12,7 @@ from baton_tpu.analysis.checkers import (  # noqa: F401
     blocking,
     counters,
     locks,
+    spans,
     staleness,
     tracer,
     wirecap,

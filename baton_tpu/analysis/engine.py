@@ -102,6 +102,38 @@ class Suppressions:
         )
 
 
+def _normalize_registry(reg) -> Optional[dict]:
+    """Metric-registry normalization for BTL030.
+
+    Accepts the legacy 2-tuple ``(declared_counters, counter_prefixes)``
+    (timer/gauge audit disabled — pre-existing fixtures keep passing)
+    or the full dict shape with ``counters`` / ``counter_prefixes`` /
+    ``timers`` / ``gauges`` keys, where ``timers``/``gauges`` may be
+    None to disable that audit."""
+    if reg is None:
+        return None
+    if isinstance(reg, dict):
+        return {
+            "counters": frozenset(reg.get("counters", ())),
+            "counter_prefixes": tuple(reg.get("counter_prefixes", ())),
+            "timers": (
+                frozenset(reg["timers"])
+                if reg.get("timers") is not None else None
+            ),
+            "gauges": (
+                frozenset(reg["gauges"])
+                if reg.get("gauges") is not None else None
+            ),
+        }
+    names, prefixes = reg
+    return {
+        "counters": frozenset(names),
+        "counter_prefixes": tuple(prefixes),
+        "timers": None,
+        "gauges": None,
+    }
+
+
 class CheckContext:
     """Everything a checker may need about the file under analysis."""
 
@@ -110,16 +142,18 @@ class CheckContext:
         path: str,
         source: str,
         tree: ast.Module,
-        counter_registry: Optional[Tuple[frozenset, tuple]] = None,
+        counter_registry=None,
     ) -> None:
         self.path = path
         self.posix_path = pathlib.PurePath(path).as_posix()
         self.parts = pathlib.PurePath(path).parts
         self.source = source
         self.tree = tree
-        # BTL030: (declared_names, declared_prefixes), resolved by the
-        # runner from baton_tpu/utils/metrics.py or injected by tests.
-        self.counter_registry = counter_registry
+        # BTL030: normalized metric registry dict (counters / prefixes /
+        # timers / gauges), resolved by the runner from
+        # baton_tpu/utils/metrics.py or injected by tests (legacy
+        # 2-tuple accepted).
+        self.counter_registry = _normalize_registry(counter_registry)
 
 
 class Checker:
@@ -294,7 +328,7 @@ def run_source(
     source: str,
     path: str = "<string>",
     rules: Optional[Sequence[str]] = None,
-    counter_registry: Optional[Tuple[frozenset, tuple]] = None,
+    counter_registry=None,
     report: Optional[Report] = None,
 ) -> List[Finding]:
     """Lint one source string (the unit-test entry point).
@@ -350,15 +384,17 @@ def iter_python_files(paths: Sequence[str]) -> List[pathlib.Path]:
 
 def _resolve_counter_registry(
     path: pathlib.Path,
-    cache: Dict[str, Optional[Tuple[frozenset, tuple]]],
-) -> Optional[Tuple[frozenset, tuple]]:
-    """Find the package's declared-counter registry for a checked file.
+    cache: Dict[str, Optional[dict]],
+) -> Optional[dict]:
+    """Find the package's declared-metric registry for a checked file.
 
     Walks the file's ancestors for a ``baton_tpu/utils/metrics.py``
     (covering both in-repo paths and fixture trees) and parses its
-    ``DECLARED_COUNTERS`` / ``DECLARED_COUNTER_PREFIXES`` literals with
+    ``DECLARED_COUNTERS`` / ``DECLARED_COUNTER_PREFIXES`` /
+    ``DECLARED_TIMERS`` / ``DECLARED_GAUGES`` literals with
     ``ast.literal_eval`` — no import, so linting never executes package
-    code. ``None`` (registry not found) disables BTL030 for the file.
+    code. ``None`` (registry not found) disables BTL030 for the file;
+    a registry without timer/gauge sets disables just those audits.
     """
     for ancestor in [path.parent, *path.parent.parents]:
         for candidate in (
@@ -381,13 +417,15 @@ def _resolve_counter_registry(
 
 def _parse_counter_registry(
     metrics_path: pathlib.Path,
-) -> Optional[Tuple[frozenset, tuple]]:
+) -> Optional[dict]:
     try:
         tree = ast.parse(metrics_path.read_text(encoding="utf-8"))
     except (OSError, SyntaxError):
         return None
     names: Optional[frozenset] = None
     prefixes: tuple = ()
+    timers: Optional[frozenset] = None
+    gauges: Optional[frozenset] = None
     for node in tree.body:
         if not isinstance(node, ast.Assign) or len(node.targets) != 1:
             continue
@@ -411,9 +449,18 @@ def _parse_counter_registry(
             names = frozenset(str(x) for x in literal)
         elif target.id == "DECLARED_COUNTER_PREFIXES":
             prefixes = tuple(str(x) for x in literal)
+        elif target.id == "DECLARED_TIMERS":
+            timers = frozenset(str(x) for x in literal)
+        elif target.id == "DECLARED_GAUGES":
+            gauges = frozenset(str(x) for x in literal)
     if names is None:
         return None
-    return names, prefixes
+    return {
+        "counters": names,
+        "counter_prefixes": prefixes,
+        "timers": timers,
+        "gauges": gauges,
+    }
 
 
 def run_paths(
@@ -430,7 +477,7 @@ def run_paths(
     project pass still reads everything.
     """
     report = Report()
-    registry_cache: Dict[str, Optional[Tuple[frozenset, tuple]]] = {}
+    registry_cache: Dict[str, Optional[dict]] = {}
     files = iter_python_files(paths)
     if not files:
         report.errors.append(f"no Python files under: {', '.join(paths)}")

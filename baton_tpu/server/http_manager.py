@@ -77,7 +77,9 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import re
+import time
 from typing import Any, Dict, Optional
 
 import aiohttp
@@ -102,7 +104,10 @@ from baton_tpu.server.utils import (
     read_body_capped,
     read_json_capped,
 )
-from baton_tpu.utils.metrics import Metrics
+from baton_tpu.utils import tracing
+from baton_tpu.utils.metrics import LoopLagProbe, Metrics
+from baton_tpu.utils.slog import RoundsLog
+from baton_tpu.utils.tracing import trace_headers
 
 DEFAULT_N_EPOCH = 32  # reference manager.py:52-55
 
@@ -177,6 +182,8 @@ class Experiment:
         ingest_queue_depth: int = 64,
         fold_shards: int = 1,
         max_chunk_sessions: int = 64,
+        trace_dir: Optional[str] = None,
+        rounds_log_path: Optional[str] = None,
     ):
         """``aggregator``: ``"mean"`` (sample-weighted FedAvg, reference
         manager.py:119-126), or Byzantine-robust ``"trimmed:<ratio>"`` /
@@ -268,7 +275,22 @@ class Experiment:
 
         ``max_chunk_sessions``: cap on concurrently assembling chunked
         uploads (each can hold up to ``max_upload_bytes``); beyond it
-        new sessions get ``429``."""
+        new sessions get ``429``.
+
+        ``trace_dir``: enable the distributed round tracer's crash
+        spool (baton_tpu/utils/tracing.py): every finished span is
+        appended to ``<trace_dir>/<trace_id>.jsonl`` eagerly, so a
+        manager killed mid-round loses its heap but not its spans, and
+        the recovered incarnation's ``GET /{name}/rounds/{rid}/trace``
+        still covers both incarnations. Tracing itself (in-memory
+        spans, traceparent propagation, the trace endpoint) is always
+        on; the spool is the only part that needs a path.
+
+        ``rounds_log_path``: append one SLO summary record per
+        finished/aborted round (participants, stragglers, per-round
+        counter deltas, phase durations) to this JSONL file — the data
+        contract the scenario harness consumes
+        (baton_tpu/utils/slog.py::RoundsLog)."""
         if secure_agg and allow_pickle:
             raise ValueError(
                 "secure_agg is incompatible with allow_pickle: reference-"
@@ -381,6 +403,19 @@ class Experiment:
             name, round_timeout=round_timeout, journal=self.journal
         )
         self.metrics = metrics or Metrics()
+        # Distributed round tracing. The service label is
+        # per-INCARNATION (random suffix): a chaos test runs a killed
+        # manager and its replacement in one OS process, and the trace
+        # must attribute each span to the incarnation that emitted it.
+        self.tracer = tracing.Tracer(
+            service=f"manager#{os.urandom(2).hex()}", spool_dir=trace_dir
+        )
+        self.rounds_log = (
+            RoundsLog(rounds_log_path) if rounds_log_path else None
+        )
+        self._loop_probe = LoopLagProbe(self.metrics)
+        # counter snapshot at round start — rounds.jsonl records deltas
+        self._slo_base: Optional[dict] = None
         # uplink ingest pipeline (None = legacy fully-on-loop path)
         self._ingest = (
             IngestPipeline(
@@ -388,6 +423,7 @@ class Experiment:
                 queue_depth=ingest_queue_depth,
                 fold_shards=fold_shards,
                 metrics=self.metrics,
+                tracer=self.tracer,
             )
             if ingest_workers > 0
             else None
@@ -522,6 +558,8 @@ class Experiment:
             return
         self.rounds.resume_round(round_name, **meta)
         self.metrics.inc("recovery_rounds_resumed")
+        self._slo_base = self.metrics.snapshot()["counters"]
+        trace_id = tracing.make_trace_id(self.name, round_name)
         _log.info(
             "%s: resuming round %s with %d participants",
             self.name, round_name, len(cohort),
@@ -552,23 +590,40 @@ class Experiment:
             ctype = "application/json"
         self._broadcasting = True
         try:
-            await bounded_gather(
-                *[self._notify_client(cid, body, ctype) for cid in cohort],
-                limit=self.fanout_concurrency,
-            )
+            # recovery re-announce is a span of the ORIGINAL round's
+            # trace: the new incarnation's spans land in the same trace
+            # id (derived from the round name), so an exported trace
+            # shows both manager lifetimes and the recovery gap between
+            with self.tracer.span(
+                "recovery_rebroadcast",
+                trace_id=trace_id,
+                parent_id=tracing.root_span_id(trace_id),
+                round=round_name,
+                cohort=len(cohort),
+            ):
+                await bounded_gather(
+                    *[self._notify_client(cid, body, ctype) for cid in cohort],
+                    limit=self.fanout_concurrency,
+                )
         finally:
             self._broadcasting = False
             # the reporting window starts NOW: the broadcast itself must
             # not count against the participants' round_timeout
             self.rounds.restart_clock()
         if self.rounds.in_progress and not len(self.rounds):
+            started_wall = self.rounds.started_wall
             self.rounds.abort_round("resume broadcast unacknowledged")
             self.metrics.inc("recovery_rounds_aborted")
+            self._finish_round_obs(
+                round_name, "aborted:resume_unacknowledged",
+                started_wall=started_wall,
+            )
             return
         self._maybe_finish()
 
     # ------------------------------------------------------------------
     async def _start_background(self, app=None) -> None:
+        self._loop_probe.start()
         cull = PeriodicTask(self._cull_tick, max(self.registry.client_ttl / 2, 1))
         self._background = [cull.start()]
         if self.rounds.round_timeout is not None:
@@ -582,6 +637,7 @@ class Experiment:
             )
 
     async def _stop_background(self, app=None) -> None:
+        self._loop_probe.stop()
         for task in self._background:
             await task.stop()
         if self._recovery_task is not None:
@@ -650,6 +706,12 @@ class Experiment:
         r.add_get(
             f"/{self.name}/round_blob/{{digest}}", self.handle_round_blob
         )
+        # distributed tracing: export one round's trace; ingest workers'
+        # shipped spans into it
+        r.add_get(
+            f"/{self.name}/rounds/{{rid}}/trace", self.handle_round_trace
+        )
+        r.add_post(f"/{self.name}/trace_spans", self.handle_trace_spans)
 
     # -- v2 pull data plane --------------------------------------------
     _RANGE_RE = re.compile(r"bytes=(\d+)-(\d*)$")
@@ -763,11 +825,126 @@ class Experiment:
         return web.json_response([float(x) for x in self.rounds.loss_history])
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        from baton_tpu.server import secure
+
         snap = self.metrics.snapshot()
         snap["gauges"]["clients_registered"] = float(len(self.registry))
         snap["gauges"]["rounds_completed"] = float(self.rounds.n_rounds)
         snap["gauges"]["round_in_progress"] = float(self.rounds.in_progress)
+        dh = secure.dh_cache_stats()
+        snap["gauges"]["dh_cache_size"] = float(dh["size"])
+        snap["gauges"]["dh_cache_hits"] = float(dh["hits"])
+        snap["gauges"]["dh_cache_misses"] = float(dh["misses"])
         return web.json_response(snap)
+
+    # -- distributed tracing -------------------------------------------
+    def _round_trace_id(self, rid: str) -> str:
+        """A trace id from either a full round name or a bare round
+        index (``7`` → ``update_{name}_00007``)."""
+        round_name = (
+            f"update_{self.name}_{int(rid):05d}" if rid.isdigit() else rid
+        )
+        return tracing.make_trace_id(self.name, round_name)
+
+    async def handle_round_trace(self, request: web.Request) -> web.Response:
+        """``GET /{name}/rounds/{rid}/trace`` → Chrome ``trace_event``
+        JSON for one round (load it straight into Perfetto). ``rid`` is
+        the round name or its numeric index. Export reads the crash
+        spool, so it runs off-loop."""
+        trace_id = self._round_trace_id(request.match_info["rid"])
+        export = await asyncio.to_thread(self.tracer.export, trace_id)
+        if not export["traceEvents"]:
+            return web.json_response({"err": "Unknown Trace"}, status=404)
+        return web.json_response(export)
+
+    async def handle_trace_spans(self, request: web.Request) -> web.Response:
+        """``POST /{name}/trace_spans`` — authenticated span upstream:
+        workers ship their finished spans here after delivering an
+        update, so one endpoint serves the whole distributed trace."""
+        try:
+            self.registry.verify(
+                request.query.get("client_id", ""),
+                request.query.get("key", ""),
+            )
+        except (UnknownClient, AuthError):
+            return web.json_response({"err": "Unauthorized"}, status=401)
+        try:
+            data = await read_json_capped(request)
+        except BodyTooLarge as exc:
+            self.metrics.inc("control_rejected_413")
+            return web.json_response(
+                {"err": "Body Too Large", "limit_bytes": exc.limit},
+                status=413,
+            )
+        spans = data.get("spans") if isinstance(data, dict) else data
+        if not isinstance(spans, list):
+            return web.json_response({"err": "Bad Span List"}, status=400)
+        # ingest validates per-span and appends to the crash spool —
+        # file writes, so keep it off the loop
+        accepted = await asyncio.to_thread(self.tracer.ingest, spans)
+        self.metrics.inc("trace_spans_ingested", accepted)
+        if accepted < len(spans):
+            self.metrics.inc("trace_spans_rejected", len(spans) - accepted)
+        return web.json_response({"accepted": accepted})
+
+    def _finish_round_obs(
+        self,
+        round_name: str,
+        outcome: str,
+        participants=(),
+        responses: Optional[dict] = None,
+        started_wall: Optional[float] = None,
+    ) -> None:
+        """Round-end observability: emit the round's ROOT span
+        retroactively (deterministic span id — phase spans were already
+        parent-linked to it) and append the SLO record to rounds.jsonl.
+        Called from every path that finishes or aborts a round."""
+        trace_id = tracing.make_trace_id(self.name, round_name)
+        end = time.time()
+        t0 = started_wall if started_wall is not None else end
+        self.tracer.record_span(
+            "round",
+            trace_id=trace_id,
+            span_id=tracing.root_span_id(trace_id),
+            start=t0,
+            end=end,
+            round=round_name,
+            outcome=outcome,
+        )
+        if self.rounds_log is None:
+            return
+        responses = responses or {}
+        participants = sorted(participants)
+        reporters = sorted(responses)
+        base = self._slo_base or {}
+        self._slo_base = None
+        counters = self.metrics.snapshot()["counters"]
+        deltas = {
+            k: v - base.get(k, 0.0)
+            for k, v in counters.items()
+            if v != base.get(k, 0.0)
+        }
+        phases = {
+            s["name"]: round(s["end"] - s["start"], 6)
+            for s in self.tracer.spans_for(trace_id)
+            if s.get("service") == self.tracer.service
+            and s.get("name") != "round"
+        }
+        self.rounds_log.append({
+            "round": round_name,
+            "round_index": self.rounds.n_rounds,
+            "trace_id": trace_id,
+            "service": self.tracer.service,
+            "outcome": outcome,
+            "duration_s": round(end - t0, 6),
+            "participants": len(participants),
+            "reporters": len(reporters),
+            "stragglers": [c for c in participants if c not in responses],
+            "bytes_uploaded": deltas.get("bytes_uploaded", 0.0),
+            "bytes_broadcast": deltas.get("bytes_broadcast", 0.0),
+            "counters_delta": deltas,
+            "phase_s": phases,
+        })
 
     def _new_stream_acc(self):
         """The round's streaming accumulator: sequential (deterministic)
@@ -799,7 +976,19 @@ class Experiment:
             self.metrics.inc("uploads_rejected_413")
             return web.json_response({"err": "Payload Too Large"}, status=413)
         self.metrics.inc("bytes_uploaded", len(body))
-        return await self._ingest_update(client_id, body, request.content_type)
+        ctx = tracing.parse_traceparent(request.headers.get("traceparent"))
+        if ctx is None:
+            return await self._ingest_update(
+                client_id, body, request.content_type
+            )
+        # join the caller's trace: the worker's upload span is the parent
+        with self.tracer.span(
+            "ingest", trace_id=ctx[0], parent_id=ctx[1],
+            client=client_id, bytes=len(body),
+        ):
+            return await self._ingest_update(
+                client_id, body, request.content_type
+            )
 
     def _make_upload_decoder(self, body: bytes, content_type):
         """Build the decode+validate closure the ingest pipeline runs on
@@ -1102,9 +1291,23 @@ class Experiment:
             self.metrics.inc("chunk_bytes_received", len(chunk))
             if sess.offset < sess.total:
                 return web.json_response({"offset": sess.offset})
-            resp = await self._ingest_update(
-                client_id, bytes(sess.buf), wire.CONTENT_TYPE
+            ctx = tracing.parse_traceparent(
+                request.headers.get("traceparent")
             )
+            if ctx is None:
+                resp = await self._ingest_update(
+                    client_id, bytes(sess.buf), wire.CONTENT_TYPE
+                )
+            else:
+                # the FINAL chunk's traceparent parents the assembly
+                # ingest — one span per assembled upload, not per chunk
+                with self.tracer.span(
+                    "ingest", trace_id=ctx[0], parent_id=ctx[1],
+                    client=client_id, bytes=sess.total, chunked=True,
+                ):
+                    resp = await self._ingest_update(
+                        client_id, bytes(sess.buf), wire.CONTENT_TYPE
+                    )
         finally:
             sess.busy = False
         if resp.status == 429:
@@ -1203,6 +1406,8 @@ class Experiment:
 
     async def start_round(self, n_epoch: int) -> Dict[str, bool]:
         round_name = self.rounds.start_round(n_epoch=n_epoch)
+        self._slo_base = self.metrics.snapshot()["counters"]
+        trace_id = tracing.make_trace_id(self.name, round_name)
         self._secure_round = None  # invalidate any stale secure state
         # chunk sessions are per-round: a body assembled for the dead
         # round would only 410 at ingest, so drop the buffers now
@@ -1219,7 +1424,16 @@ class Experiment:
         # phase just squeaked under — another knife edge).
         self._broadcasting = True
         try:
-            result = await self._start_round_phases(round_name, n_epoch)
+            # all setup-phase spans hang off the round's deterministic
+            # root span id; the root itself is emitted retroactively at
+            # round end (_finish_round_obs)
+            with self.tracer.span(
+                "round_setup",
+                trace_id=trace_id,
+                parent_id=tracing.root_span_id(trace_id),
+                round=round_name,
+            ):
+                result = await self._start_round_phases(round_name, n_epoch)
         finally:
             self._broadcasting = False
             # round setup (secure phases + notify fan-out) is the
@@ -1234,11 +1448,15 @@ class Experiment:
     async def _start_round_phases(
         self, round_name: str, n_epoch: int
     ) -> Dict[str, bool]:
+        started_wall = self.rounds.started_wall
         for cid in self.registry.cull():
             self.rounds.drop_client(cid)
         if not len(self.registry) and self.simulator is None:
             # Fix of SURVEY §2.9 item 3: abort releases the round.
             self.rounds.abort_round()
+            self._finish_round_obs(
+                round_name, "aborted:no_clients", started_wall=started_wall
+            )
             return {}
         # streaming FedAvg: created BEFORE any notify so a fast worker's
         # upload (which can land mid-broadcast) has somewhere to fold.
@@ -1313,13 +1531,14 @@ class Experiment:
             # Bonawitz round 0 (AdvertiseKeys): per-round DH key
             # agreement. Clients that fail are excluded BEFORE the pk
             # directory circulates.
-            pk_results = await bounded_gather(
-                *[
-                    self._collect_pk(cid, round_name)
-                    for cid in cohort_ids
-                ],
-                limit=self.fanout_concurrency,
-            )
+            with self.tracer.span("secure_keys", cohort=len(cohort_ids)):
+                pk_results = await bounded_gather(
+                    *[
+                        self._collect_pk(cid, round_name)
+                        for cid in cohort_ids
+                    ],
+                    limit=self.fanout_concurrency,
+                )
             pks = {cid: p for cid, p in pk_results if p is not None}
             if not pks:
                 # observable abort: a silent {} return made a whole
@@ -1330,6 +1549,10 @@ class Experiment:
                     "%s: secure round aborted — no member advertised "
                     "keys (cohort %d)", self.name, len(cohort_ids))
                 self.rounds.abort_round()
+                self._finish_round_obs(
+                    round_name, "aborted:secure_keys",
+                    started_wall=started_wall,
+                )
                 return {}
             cohort_a = sorted(pks)
             t = len(cohort_a) // 2 + 1  # honest majority threshold
@@ -1339,13 +1562,14 @@ class Experiment:
             # the round_start broadcast. Members that fail here never
             # distributed shares, so nobody may mask toward them — the
             # masking cohort is exactly the successful sharers.
-            share_results = await bounded_gather(
-                *[
-                    self._collect_shares(cid, round_name, pks, t)
-                    for cid in cohort_a
-                ],
-                limit=self.fanout_concurrency,
-            )
+            with self.tracer.span("secure_shares", cohort=len(cohort_a)):
+                share_results = await bounded_gather(
+                    *[
+                        self._collect_shares(cid, round_name, pks, t)
+                        for cid in cohort_a
+                    ],
+                    limit=self.fanout_concurrency,
+                )
             outboxes = {cid: m for cid, m in share_results if m is not None}
             cohort = sorted(outboxes)
             if len(cohort) < t:
@@ -1358,6 +1582,10 @@ class Experiment:
                     self.name, len(cohort), len(cohort_a), t,
                     self._secure_phase_budget_s())
                 self.rounds.abort_round()
+                self._finish_round_obs(
+                    round_name, "aborted:secure_shares",
+                    started_wall=started_wall,
+                )
                 return {}
             self._secure_round = {
                 "round_name": round_name,
@@ -1428,9 +1656,10 @@ class Experiment:
                     self._notify_client(cid, shared, "application/json")
                     for cid in cohort_ids
                 ]
-        results = await bounded_gather(
-            *coros, limit=self.fanout_concurrency
-        )
+        with self.tracer.span("broadcast", cohort=len(coros)):
+            results = await bounded_gather(
+                *coros, limit=self.fanout_concurrency
+            )
 
         if self.simulator is not None:
             self.rounds.client_start("__simulated__")
@@ -1445,6 +1674,10 @@ class Experiment:
         if self.rounds.in_progress and not len(self.rounds):
             self.rounds.abort_round()
             self._secure_round = None
+            self._finish_round_obs(
+                round_name, "aborted:broadcast_unacknowledged",
+                started_wall=started_wall,
+            )
         return dict(results)
 
     def _publish_round_blobs(
@@ -1561,7 +1794,7 @@ class Experiment:
         )
         try:
             async with self._session.post(
-                url, json=payload,
+                url, json=payload, headers=trace_headers(),
                 timeout=aiohttp.ClientTimeout(
                     total=self._secure_phase_budget_s()),
             ) as resp:
@@ -1660,9 +1893,20 @@ class Experiment:
         # cohort-scaled budget instead of aiohttp's default 300 s
         post_kw = ({"timeout": aiohttp.ClientTimeout(
             total=self._secure_phase_budget_s())} if self.secure_agg else {})
+        with self.tracer.span("notify", client=client_id), \
+                self.metrics.timer("notify_s"):
+            return await self._notify_client_traced(
+                client_id, url, body, content_type, post_kw
+            )
+
+    async def _notify_client_traced(
+        self, client_id: str, url: str, body: bytes, content_type: str,
+        post_kw: dict,
+    ):
         try:
             async with self._session.post(
-                url, data=body, headers={"Content-Type": content_type},
+                url, data=body,
+                headers=trace_headers({"Content-Type": content_type}),
                 **post_kw,
             ) as resp:
                 self.metrics.inc("bytes_broadcast", len(body))
@@ -1810,8 +2054,14 @@ class Experiment:
             # every participant was culled/evicted mid-round: release the
             # round instead of leaving it locked forever (423 on all
             # future start_round calls — the §2.9 item 3 failure class)
+            round_name = self.rounds.round_name
+            started_wall = self.rounds.started_wall
             self.rounds.abort_round()
             self._secure_round = None
+            self._finish_round_obs(
+                round_name, "aborted:all_participants_lost",
+                started_wall=started_wall,
+            )
         elif self.rounds.clients_left == 0:
             self.end_round()
 
@@ -1834,6 +2084,10 @@ class Experiment:
                 self._secure_task = loop.create_task(self._end_round_secure())
             return
         n_epoch = (self.rounds.round_meta or {}).get("n_epoch", 0)
+        round_name = self.rounds.round_name
+        started_wall = self.rounds.started_wall
+        participants = set(self.rounds.clients)
+        trace_id = tracing.make_trace_id(self.name, round_name)
         self.metrics.observe("round_s", self.rounds.elapsed)
         acc, self._stream_acc = self._stream_acc, None
         if self._ingest is not None:
@@ -1843,30 +2097,50 @@ class Experiment:
             self._ingest.drain_folds()
         responses = self.rounds.end_round()
         self.metrics.inc("rounds_finished")
-        reports = [r for r in responses.values() if r.get("n_samples", 0) > 0]
-        if not reports:
-            return
-        if acc is not None:
-            # streaming FedAvg: the per-update tensors were folded (and
-            # freed) in handle_update — the merge is one division
-            merged = acc.mean()
-            if merged is None:
+        try:
+            reports = [
+                r for r in responses.values() if r.get("n_samples", 0) > 0
+            ]
+            if not reports:
                 return
-            self.params = state_dict_to_params(self.params, merged)
-        else:
-            weights = jnp.asarray(
-                [r["n_samples"] for r in reports], jnp.float32
+            with self.tracer.span(
+                "aggregate",
+                trace_id=trace_id,
+                parent_id=tracing.root_span_id(trace_id),
+                reports=len(reports),
+            ):
+                if acc is not None:
+                    # streaming FedAvg: the per-update tensors were
+                    # folded (and freed) in handle_update — the merge
+                    # is one division
+                    merged = acc.mean()
+                    if merged is None:
+                        return
+                    self.params = state_dict_to_params(self.params, merged)
+                else:
+                    weights = jnp.asarray(
+                        [r["n_samples"] for r in reports], jnp.float32
+                    )
+                    template = params_to_state_dict(self.params)
+                    stacked = {
+                        k: jnp.stack(
+                            [np.asarray(r["state_dict"][k]) for r in reports]
+                        )
+                        for k in template
+                    }
+                    merged = agg.apply_aggregator(
+                        self.aggregator, stacked, weights
+                    )
+                    self.params = state_dict_to_params(
+                        self.params,
+                        {k: np.asarray(v) for k, v in merged.items()},
+                    )
+            self._record_history_and_checkpoint(reports, n_epoch)
+        finally:
+            self._finish_round_obs(
+                round_name, "completed", participants, responses,
+                started_wall=started_wall,
             )
-            template = params_to_state_dict(self.params)
-            stacked = {
-                k: jnp.stack([np.asarray(r["state_dict"][k]) for r in reports])
-                for k in template
-            }
-            merged = agg.apply_aggregator(self.aggregator, stacked, weights)
-            self.params = state_dict_to_params(
-                self.params, {k: np.asarray(v) for k, v in merged.items()}
-            )
-        self._record_history_and_checkpoint(reports, n_epoch)
 
     async def _end_round_secure(self) -> None:
         """Secure-round finalization — Bonawitz round 3 (Unmasking).
@@ -1895,6 +2169,9 @@ class Experiment:
             # await window) must not consume the round out from under it
             return
         self._secure_finalizing = True
+        round_name = sr["round_name"]
+        started_wall = self.rounds.started_wall
+        trace_id = tracing.make_trace_id(self.name, round_name)
         try:
             # a masked upload is a reporter regardless of n_samples: its
             # masks are IN the modular sum, so it must not also be
@@ -1912,18 +2189,29 @@ class Experiment:
                 self.metrics.inc("secure_rounds_unrecoverable")
                 self.rounds.abort_round()
                 self._secure_round = None
+                self._finish_round_obs(
+                    round_name, "aborted:secure_below_threshold",
+                    sr["cohort"], reporters, started_wall=started_wall,
+                )
                 return
             template = params_to_state_dict(self.params)
-            bundles = await bounded_gather(
-                *[
-                    self._request_unmask(
-                        rid, sr["round_name"], survivors, dropped,
-                        sr["c_pks"][rid],
-                    )
-                    for rid in survivors
-                ],
-                limit=self.fanout_concurrency,
-            )
+            with self.tracer.span(
+                "secure_unmask",
+                trace_id=trace_id,
+                parent_id=tracing.root_span_id(trace_id),
+                survivors=len(survivors),
+                dropped=len(dropped),
+            ):
+                bundles = await bounded_gather(
+                    *[
+                        self._request_unmask(
+                            rid, sr["round_name"], survivors, dropped,
+                            sr["c_pks"][rid],
+                        )
+                        for rid in survivors
+                    ],
+                    limit=self.fanout_concurrency,
+                )
             # collect shares by secret owner; x-indices were fixed at
             # share time, so partial responses compose correctly
             b_shares: Dict[str, Dict[int, int]] = {s: {} for s in survivors}
@@ -1961,6 +2249,10 @@ class Experiment:
                 self.metrics.inc("secure_rounds_unrecoverable")
                 self.rounds.abort_round()
                 self._secure_round = None
+                self._finish_round_obs(
+                    round_name, "aborted:secure_shares_short",
+                    sr["cohort"], reporters, started_wall=started_wall,
+                )
                 return
             # Reconstruction + mask regeneration + the modular sum are
             # the round's heaviest host compute — O(dropped×survivors)
@@ -2036,6 +2328,10 @@ class Experiment:
                 self.metrics.inc("secure_rounds_unrecoverable")
                 self.rounds.abort_round()
                 self._secure_round = None
+                self._finish_round_obs(
+                    round_name, "aborted:secure_unmask_failed",
+                    sr["cohort"], reporters, started_wall=started_wall,
+                )
                 return
             if dropped:
                 self.metrics.inc("secure_dropouts_recovered", len(dropped))
@@ -2049,6 +2345,10 @@ class Experiment:
                 self.params = state_dict_to_params(self.params, merged)
                 self._record_history_and_checkpoint(reports, n_epoch)
             self._secure_round = None
+            self._finish_round_obs(
+                round_name, "completed_secure",
+                sr["cohort"], reporters, started_wall=started_wall,
+            )
         finally:
             self._secure_finalizing = False
 

@@ -70,7 +70,9 @@ from baton_tpu.server.utils import (
     read_body_capped,
     read_json_capped,
 )
+from baton_tpu.utils import tracing
 from baton_tpu.utils.metrics import Metrics
+from baton_tpu.utils.tracing import trace_headers
 
 GetData = Callable[[], Tuple[dict, int]]
 MAX_BACKOFF = 60.0
@@ -172,6 +174,12 @@ class ExperimentWorker:
         self.name = name or getattr(model, "name", "fedmodel")
         self.model = model
         self.metrics = Metrics()
+        # span recorder for this worker's half of each round's trace;
+        # the label is upgraded to the registered client_id so traces
+        # name workers the way the manager's round state does
+        self.tracer = tracing.Tracer(
+            service=f"worker#{os.urandom(2).hex()}"
+        )
         if trainer is None:
             # default trainer gets the per-epoch metrics heartbeat (module
             # docstring). A USER-supplied trainer is kept verbatim: the
@@ -224,6 +232,7 @@ class ExperimentWorker:
             self.metrics.set_gauge("outbox_pending", 1)
             self.metrics.inc("outbox_reloaded_from_disk")
         self._outbox_task: Optional[asyncio.Task] = None
+        self._ship_task: Optional[asyncio.Task] = None
         # guards the broadcast handler's await windows (body read, boxed-
         # share decryption in a worker thread): a duplicate round_start
         # arriving mid-handler must 409 exactly like one arriving
@@ -267,6 +276,12 @@ class ExperimentWorker:
     async def _on_cleanup(self, app=None) -> None:
         if self._heartbeat_task is not None:
             await self._heartbeat_task.stop()
+        if self._ship_task is not None and not self._ship_task.done():
+            self._ship_task.cancel()
+            try:
+                await self._ship_task
+            except asyncio.CancelledError:
+                pass
         if self._outbox_task is not None and not self._outbox_task.done():
             self._outbox_task.cancel()
             try:
@@ -299,6 +314,7 @@ class ExperimentWorker:
                         data = await resp.json()
                         self.client_id = data["client_id"]
                         self.key = data["key"]
+                        self.tracer.service = f"worker:{self.client_id}"
                         break
                 except aiohttp.ClientError:
                     await asyncio.sleep(backoff)
@@ -321,14 +337,19 @@ class ExperimentWorker:
         backoff = 1.0
         while True:
             try:
-                async with self._session.get(
-                    url, json={"client_id": self.client_id, "key": self.key}
-                ) as resp:
-                    if resp.status == 200:
-                        return
-                    if resp.status == 401:
-                        # manager restarted or culled us: rejoin
-                        return await self.register_with_manager()
+                # time only the round-trip: the 401 path's re-register
+                # (with its own retry backoff) would skew the histogram
+                with self.metrics.timer("heartbeat_s"):
+                    async with self._session.get(
+                        url,
+                        json={"client_id": self.client_id, "key": self.key},
+                    ) as resp:
+                        status = resp.status
+                if status == 200:
+                    return
+                if status == 401:
+                    # manager restarted or culled us: rejoin
+                    return await self.register_with_manager()
             except aiohttp.ClientError:
                 pass
             await asyncio.sleep(backoff)
@@ -592,9 +613,17 @@ class ExperimentWorker:
             asyncio.ensure_future(self.register_with_manager())
             return web.json_response({"err": "Wrong Client"}, status=404)
         self._broadcast_busy = True
+        # join the manager's trace: the notify span's traceparent makes
+        # this broadcast's fetch/reconstruct spans (and, via the context
+        # copied into the spawned round task, the train span) children
+        # of the manager's notify
+        ctx = tracing.parse_traceparent(request.headers.get("traceparent"))
+        token = tracing.activate(ctx[0], ctx[1]) if ctx is not None else None
         try:
             return await self._handle_round_start_locked(request)
         finally:
+            if token is not None:
+                tracing.deactivate(token)
             self._broadcast_busy = False
 
     async def _handle_round_start_locked(
@@ -827,42 +856,53 @@ class ExperimentWorker:
         )
         buf = bytearray()
         base, cap = 0.2, 2.0
-        for attempt in range(max_attempts):
-            headers = {}
-            if buf:
-                # the blob is immutable under its digest, so a partial
-                # body resumes where it stopped instead of restarting
-                headers["Range"] = f"bytes={len(buf)}-"
-                self.metrics.inc("blob_range_resumes")
-            try:
-                async with self._session.get(url, headers=headers) as resp:
-                    if resp.status == 200 and buf:
-                        buf.clear()  # server ignored the Range: restart
-                    if resp.status in (200, 206):
-                        async for chunk in resp.content.iter_chunked(1 << 16):
-                            buf.extend(chunk)
-                            if len(buf) > size:
-                                # a server streaming MORE than the
-                                # envelope's declared size can never
-                                # verify — stop buffering it now instead
-                                # of after an unbounded read
-                                break
-                    elif resp.status in (404, 410):
-                        return None  # blob gone (round rolled): give up
-                    else:
-                        buf.clear()  # 416/401/5xx: restart clean
-            except (aiohttp.ClientError, asyncio.TimeoutError):
-                pass  # partial body stays in buf; next attempt resumes
-            if len(buf) == size:
-                if hashlib.sha256(buf).hexdigest() == digest:
-                    return bytes(buf)
-                buf.clear()  # corrupt assembly: restart from scratch
-            elif len(buf) > size:
-                buf.clear()
-            if attempt < max_attempts - 1:
-                delay = min(base * (2 ** attempt), cap)
-                await asyncio.sleep(delay * (0.5 + random.random() / 2))
-        return None
+        with self.tracer.span(
+            "fetch_blob", digest=digest[:12], size=size
+        ) as fetch_sp:
+            for attempt in range(max_attempts):
+                headers = trace_headers()
+                if buf:
+                    # the blob is immutable under its digest, so a partial
+                    # body resumes where it stopped instead of restarting
+                    headers["Range"] = f"bytes={len(buf)}-"
+                    self.metrics.inc("blob_range_resumes")
+                try:
+                    async with self._session.get(
+                        url, headers=headers
+                    ) as resp:
+                        if resp.status == 200 and buf:
+                            buf.clear()  # server ignored the Range: restart
+                        if resp.status in (200, 206):
+                            async for chunk in resp.content.iter_chunked(
+                                1 << 16
+                            ):
+                                buf.extend(chunk)
+                                if len(buf) > size:
+                                    # a server streaming MORE than the
+                                    # envelope's declared size can never
+                                    # verify — stop buffering it now
+                                    # instead of after an unbounded read
+                                    break
+                        elif resp.status in (404, 410):
+                            # blob gone (round rolled): give up
+                            fetch_sp.set(outcome="gone")
+                            return None
+                        else:
+                            buf.clear()  # 416/401/5xx: restart clean
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    pass  # partial body stays in buf; next attempt resumes
+                if len(buf) == size:
+                    if hashlib.sha256(buf).hexdigest() == digest:
+                        fetch_sp.set(attempts=attempt + 1)
+                        return bytes(buf)
+                    buf.clear()  # corrupt assembly: restart from scratch
+                elif len(buf) > size:
+                    buf.clear()
+                if attempt < max_attempts - 1:
+                    delay = min(base * (2 ** attempt), cap)
+                    await asyncio.sleep(delay * (0.5 + random.random() / 2))
+            fetch_sp.set(outcome="exhausted")
+            return None
 
     async def _accept_broadcast(
         self, round_name: str, n_epoch: int, new_params, secure_info
@@ -1008,7 +1048,18 @@ class ExperimentWorker:
                 )
                 return params, np.asarray(losses)
 
-            params, loss_history = await asyncio.to_thread(train)
+            # explicit derived trace id: under a live traceparent
+            # context (copied into this task at ensure_future) the span
+            # parents to the manager's notify; on a legacy broadcast
+            # with no context it still joins the round's derived trace
+            trace_id = tracing.make_trace_id(self.name, round_name)
+            with self.tracer.span(
+                "local_train", trace_id=trace_id, round=round_name,
+                n_epoch=n_epoch, n_samples=n_samples,
+            ) as train_sp:
+                params, loss_history = await asyncio.to_thread(train)
+                if len(loss_history):
+                    train_sp.set(final_loss=float(loss_history[-1]))
             self.params = params
             await self.report_update(round_name, n_samples, loss_history)
         finally:
@@ -1225,6 +1276,14 @@ class ExperimentWorker:
                 self.metrics.set_gauge("outbox_pending", 0)
                 self.n_updates += 1
                 self.metrics.inc("updates_delivered")
+                # fire-and-forget: shipping spans must neither delay the
+                # next slot nor add an await window between the slot
+                # snapshot and its use (the BTL003 staleness rule)
+                self._ship_task = asyncio.ensure_future(
+                    self._ship_spans(
+                        tracing.make_trace_id(self.name, p.round_name)
+                    )
+                )
                 continue
             if status == 410:
                 # the round is gone (aborted, force-ended, or we were
@@ -1251,6 +1310,29 @@ class ExperimentWorker:
                 await self.register_with_manager()
             await asyncio.sleep(delay)
 
+    async def _ship_spans(self, trace_id: str) -> None:
+        """Ship this round's finished spans upstream (``POST
+        /{name}/trace_spans``) so the manager's trace endpoint serves
+        the distributed round in one document. Best-effort and
+        fire-after-delivery: spans are observability, not protocol
+        state — a failed ship drops them (counted) rather than blocking
+        or re-queueing the outbox."""
+        spans = self.tracer.drain(trace_id)
+        if not spans:
+            return
+        url = (
+            self.manager_url
+            + f"trace_spans?client_id={self.client_id}&key={self.key}"
+        )
+        try:
+            async with self._session.post(url, json=spans) as resp:
+                if resp.status == 200:
+                    self.metrics.inc("trace_spans_shipped", len(spans))
+                else:
+                    self.metrics.inc("trace_ship_failed")
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            self.metrics.inc("trace_ship_failed")
+
     @staticmethod
     def _retry_after_s(resp) -> Optional[float]:
         """Parse a Retry-After header (seconds form) from a response;
@@ -1271,23 +1353,42 @@ class ExperimentWorker:
         credentials may have rotated via a 401 → re-register cycle
         between attempts. Bodies above ``upload_chunk_bytes`` go through
         the chunked resumable path."""
-        if (
+        chunked = (
             self.upload_chunk_bytes is not None
             and len(p.body) > self.upload_chunk_bytes
-        ):
-            return await self._post_update_chunked(p)
-        url = (
-            self.manager_url
-            + f"update?client_id={self.client_id}&key={self.key}"
         )
-        try:
-            async with self._session.post(
-                url, data=p.body,
-                headers={"Content-Type": wire.CONTENT_TYPE},
-            ) as resp:
-                return resp.status, self._retry_after_s(resp)
-        except (aiohttp.ClientError, asyncio.TimeoutError):
-            return None, None  # manager down; the backoff loop keeps trying
+        # the outbox task may outlive the round task's copied context:
+        # derive the round's trace id from the slot itself so a retry
+        # hours later (or after a crash-reload) still joins the right
+        # trace, parented to the round's deterministic root span
+        trace_id = tracing.make_trace_id(self.name, p.round_name)
+        with self.tracer.span(
+            "upload", trace_id=trace_id,
+            parent_id=tracing.root_span_id(trace_id),
+            round=p.round_name, bytes=len(p.body),
+            attempt=p.attempts + 1, chunked=chunked,
+        ) as up_sp:
+            if chunked:
+                status, retry_after = await self._post_update_chunked(p)
+                up_sp.set(status=status)
+                return status, retry_after
+            url = (
+                self.manager_url
+                + f"update?client_id={self.client_id}&key={self.key}"
+            )
+            try:
+                async with self._session.post(
+                    url, data=p.body,
+                    headers=trace_headers(
+                        {"Content-Type": wire.CONTENT_TYPE}
+                    ),
+                ) as resp:
+                    up_sp.set(status=resp.status)
+                    return resp.status, self._retry_after_s(resp)
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                # manager down; the backoff loop keeps trying
+                up_sp.set(status=None)
+                return None, None
 
     async def _post_update_chunked(
         self, p: _PendingUpdate
@@ -1307,7 +1408,13 @@ class ExperimentWorker:
             + f"?client_id={self.client_id}&key={self.key}"
         )
         try:
-            async with self._session.get(base) as resp:
+            # called under _post_update's "upload" span: trace_headers()
+            # picks the active context up, so the probe and every PUT
+            # below carry the same traceparent — the manager's assembly
+            # ingest span parents off the final chunk's copy of it
+            async with self._session.get(
+                base, headers=trace_headers()
+            ) as resp:
                 if resp.status == 401:
                     return 401, self._retry_after_s(resp)
                 if resp.status == 200:
@@ -1329,7 +1436,9 @@ class ExperimentWorker:
                 self.metrics.inc("chunk_bytes_put", end - offset)
                 async with self._session.put(
                     url, data=p.body[offset:end],
-                    headers={"Content-Type": wire.CONTENT_TYPE},
+                    headers=trace_headers(
+                        {"Content-Type": wire.CONTENT_TYPE}
+                    ),
                 ) as resp:
                     if resp.status == 409:
                         # the manager's committed offset is authoritative

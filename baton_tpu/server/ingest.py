@@ -30,8 +30,13 @@ This module gives the manager a two-stage pipeline instead:
   fp32 reduction order.
 
 The pipeline reports ``ingest_queue_depth`` (gauge), and
-``ingest_decode_s`` / ``ingest_fold_s`` (timers) through the manager's
-metrics registry.
+``ingest_decode_s`` / ``ingest_fold_s`` (histogram timers with
+p50/p95/p99) through the manager's metrics registry. With a ``tracer``
+it also records per-stage ``ingest_decode`` / ``ingest_fold`` spans
+into the caller's trace: the context is captured *on the loop* at
+submit time (executors don't propagate contextvars), so the spans land
+under the handler's ``ingest`` span and the exported round trace shows
+queue wait vs. execution per upload.
 
 :class:`ChunkSession` is the server half of the chunked resumable
 upload protocol (``PUT /{name}/update_chunk/{update_id}`` with
@@ -49,6 +54,8 @@ from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import wait as _futures_wait
 from typing import Any, Callable, List, Optional
 
+from baton_tpu.utils import tracing
+
 
 class IngestPipeline:
     """Bounded off-loop decode pool + ordered fold lanes.
@@ -65,6 +72,7 @@ class IngestPipeline:
         fold_shards: int = 1,
         metrics=None,
         retry_after_s: float = 1.0,
+        tracer=None,
     ) -> None:
         if workers < 1:
             raise ValueError(f"workers must be >= 1, got {workers}")
@@ -76,6 +84,7 @@ class IngestPipeline:
         self.queue_depth = int(queue_depth)
         self.retry_after_s = float(retry_after_s)
         self._metrics = metrics
+        self._tracer = tracer
         self._lock = threading.Lock()
         self._inflight = 0
         self._decode_pool: Optional[ThreadPoolExecutor] = None
@@ -125,9 +134,13 @@ class IngestPipeline:
             self._inflight += 1
             depth = self._inflight
         self._set_depth_gauge(depth)
+        # executors don't carry contextvars: snapshot the caller's trace
+        # context here, on the loop, for the stage span recorded below
+        ctx = tracing.current_context() if self._tracer is not None else None
 
         def run():
             t0 = time.perf_counter()
+            w0 = time.time()
             try:
                 return fn()
             finally:
@@ -135,9 +148,14 @@ class IngestPipeline:
                     self._inflight -= 1
                     left = self._inflight
                 self._set_depth_gauge(left)
+                dt = time.perf_counter() - t0
                 if self._metrics is not None:
-                    self._metrics.observe(
-                        "ingest_decode_s", time.perf_counter() - t0)
+                    self._metrics.observe("ingest_decode_s", dt)
+                if ctx is not None:
+                    self._tracer.record_span(
+                        "ingest_decode", trace_id=ctx[0], parent_id=ctx[1],
+                        start=w0, end=w0 + dt,
+                    )
 
         return asyncio.get_running_loop().run_in_executor(self._pool(), run)
 
@@ -155,14 +173,22 @@ class IngestPipeline:
         reproduces the sequential on-loop fold bit-for-bit.
         """
 
+        ctx = tracing.current_context() if self._tracer is not None else None
+
         def run():
             t0 = time.perf_counter()
+            w0 = time.time()
             try:
                 return fn()
             finally:
+                dt = time.perf_counter() - t0
                 if self._metrics is not None:
-                    self._metrics.observe(
-                        "ingest_fold_s", time.perf_counter() - t0)
+                    self._metrics.observe("ingest_fold_s", dt)
+                if ctx is not None:
+                    self._tracer.record_span(
+                        "ingest_fold", trace_id=ctx[0], parent_id=ctx[1],
+                        start=w0, end=w0 + dt, shard=int(shard),
+                    )
 
         return asyncio.wrap_future(self._lane(shard).submit(run))
 

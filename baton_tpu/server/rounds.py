@@ -71,6 +71,11 @@ class RoundManager:
         self.update_ids: Dict[str, str] = {}
         self.round_meta: Optional[dict] = None
         self.started_at: Optional[float] = None
+        # wall-clock (epoch) round start: the injected monotonic clock
+        # is the right base for expiry math but meaningless across
+        # processes — trace spans and rounds.jsonl SLO records need a
+        # timestamp a recovered manager incarnation can line up with
+        self.started_wall: Optional[float] = None
 
     def _journal(self, event: str, **fields: Any) -> None:
         if self.journal is not None:
@@ -113,6 +118,7 @@ class RoundManager:
         self._in_progress = True
         self.round_meta = round_meta
         self.started_at = self._clock()
+        self.started_wall = time.time()
         return self.round_name
 
     def resume_round(self, round_name: str, **round_meta: Any) -> str:
@@ -132,6 +138,7 @@ class RoundManager:
         self._in_progress = True
         self.round_meta = round_meta
         self.started_at = self._clock()
+        self.started_wall = time.time()
         return self.round_name
 
     def restart_clock(self) -> None:

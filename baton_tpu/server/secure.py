@@ -150,12 +150,23 @@ _DH_CACHE_LOCK = _threading.Lock()
 # ordered with a hard cap — oldest tombstones fall off.
 _DH_PURGED: Dict[int, None] = {}
 _DH_PURGED_MAX = 4096
+# hit/miss tally for the cache — the C=256 postmortem above was, at
+# bottom, an *invisible* cache wipe; dh_cache_stats() surfaces the
+# cache's health as manager gauges so the next sizing knife edge shows
+# up on a dashboard instead of in a timeout
+_DH_CACHE_HITS = 0
+_DH_CACHE_MISSES = 0
 
 
 def _dh_raw(sk: int, pk_other: int) -> bytes:
+    global _DH_CACHE_HITS, _DH_CACHE_MISSES
     key = (sk, pk_other)
     with _DH_CACHE_LOCK:
         v = _DH_CACHE.get(key)
+        if v is None:
+            _DH_CACHE_MISSES += 1
+        else:
+            _DH_CACHE_HITS += 1
     if v is None:
         v = pow(pk_other, sk, MODP_P).to_bytes(256, "big")
         with _DH_CACHE_LOCK:
@@ -164,6 +175,18 @@ def _dh_raw(sk: int, pk_other: int) -> bytes:
                     _DH_CACHE.pop(next(iter(_DH_CACHE)))
                 _DH_CACHE[key] = v
     return v
+
+
+def dh_cache_stats() -> Dict[str, int]:
+    """Size + hit/miss counters of the process-wide DH power cache,
+    read under the cache lock (surfaced as ``dh_cache_*`` gauges by the
+    manager's ``/metrics`` endpoint)."""
+    with _DH_CACHE_LOCK:
+        return {
+            "size": len(_DH_CACHE),
+            "hits": _DH_CACHE_HITS,
+            "misses": _DH_CACHE_MISSES,
+        }
 
 
 def purge_dh_secrets(*sks: int) -> None:

@@ -1,29 +1,36 @@
-"""Metrics registry — counters, gauges, timers, JSON export.
+"""Metrics registry — counters, gauges, histogram timers, JSON export.
 
 The reference's only observability is ``print`` statements and a broken
 ``loss_history`` endpoint (SURVEY §5 "Metrics/logging"). This registry
 backs the manager's ``GET /{name}/metrics`` endpoint and the engine's
 per-round/per-wave timings. Pure Python, no deps, threadsafe enough for
 the asyncio + ``to_thread`` training model (GIL-atomic dict ops plus a
-lock around multi-field timer updates).
+lock around multi-field histogram updates).
+
+Timers are fixed-bucket log-spaced histograms: every ``observe`` lands
+in one of ``len(_BUCKET_BOUNDS)+1`` buckets, so the snapshot can report
+p50/p95/p99 with bounded error (one bucket's width, ratio √2) at O(1)
+memory per timer — the SLO quantiles the scenario harness keys on.
 """
 
 from __future__ import annotations
 
+import asyncio
+import bisect
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict
+from typing import Dict, List, Optional
 
 # ---------------------------------------------------------------------------
-# Declared counter registry.
+# Declared metric registries.
 #
-# Dashboards and alert rules key on exact counter names, so every
-# counter incremented under baton_tpu/server/ must be declared here —
-# batonlint rule BTL030 enforces it (the linter parses these literals
-# with ast.literal_eval; keep them plain literals, no computed values).
-# Counter FAMILIES whose suffix is built at runtime (f-strings keyed on
-# an HTTP status, for example) declare their static prefix in
+# Dashboards and alert rules key on exact metric names, so every
+# counter/timer/gauge touched under baton_tpu/server/ must be declared
+# here — batonlint rule BTL030 enforces it (the linter parses these
+# literals with ast.literal_eval; keep them plain literals, no computed
+# values). Counter FAMILIES whose suffix is built at runtime (f-strings
+# keyed on an HTTP status, for example) declare their static prefix in
 # DECLARED_COUNTER_PREFIXES instead.
 DECLARED_COUNTERS = frozenset({
     # manager: recovery / lifecycle
@@ -53,6 +60,9 @@ DECLARED_COUNTERS = frozenset({
     "secure_rounds_aborted_shares",
     "secure_rounds_unrecoverable",
     "secure_dropouts_recovered",
+    # manager: tracing
+    "trace_spans_ingested",
+    "trace_spans_rejected",
     # worker: secure aggregation downgrade guard
     "updates_refused_secure_downgrade",
     # worker: outbox / delivery
@@ -75,6 +85,9 @@ DECLARED_COUNTERS = frozenset({
     # worker: control plane
     "broadcast_rejected_413",
     "train_epochs_completed",
+    # worker: trace shipping
+    "trace_spans_shipped",
+    "trace_ship_failed",
 })
 
 DECLARED_COUNTER_PREFIXES = (
@@ -82,9 +95,50 @@ DECLARED_COUNTER_PREFIXES = (
     "broadcast_rejected_",  # manager: f"broadcast_rejected_{status}"
 )
 
+# Timers/histograms observed under baton_tpu/server/ (BTL030 audits
+# .observe()/.timer() names against this set).
+DECLARED_TIMERS = frozenset({
+    "round_s",          # manager: reporting-window duration per round
+    "checkpoint_s",     # manager: orbax save latency
+    "notify_s",         # manager: per-client round_start broadcast POST
+    "ingest_decode_s",  # manager: off-loop upload decode+validate
+    "ingest_fold_s",    # manager: per-shard streaming fold
+    "heartbeat_s",      # worker: heartbeat GET round-trip
+    "loop_lag_s",       # both: event-loop scheduling delay (LoopLagProbe)
+})
+
+# Gauges set under baton_tpu/server/ (BTL030 audits .set_gauge() names).
+DECLARED_GAUGES = frozenset({
+    # manager
+    "chunk_sessions_active",
+    "sim_wave",
+    "sim_waves_total",
+    "ingest_queue_depth",
+    "clients_registered",
+    "rounds_completed",
+    "round_in_progress",
+    "dh_cache_size",
+    "dh_cache_hits",
+    "dh_cache_misses",
+    # worker
+    "outbox_pending",
+    "train_epoch",
+    "train_epoch_loss",
+    # both: LoopLagProbe scheduling-delay gauge
+    "loop_lag_s",
+})
+
+
+# Log-spaced bucket upper bounds (seconds), ratio √2, 100 µs … ~1 677 s.
+# 48 buckets + one overflow keep every histogram at a fixed 49 ints.
+_BUCKET_RATIO = 2.0 ** 0.5
+_BUCKET_BOUNDS = tuple(1e-4 * _BUCKET_RATIO ** i for i in range(48))
+
 
 class _TimerStat:
-    __slots__ = ("count", "total", "min", "max", "last")
+    """One timer's fixed-bucket histogram plus the legacy scalar stats."""
+
+    __slots__ = ("count", "total", "min", "max", "last", "buckets")
 
     def __init__(self) -> None:
         self.count = 0
@@ -92,6 +146,7 @@ class _TimerStat:
         self.min = float("inf")
         self.max = 0.0
         self.last = 0.0
+        self.buckets: List[int] = [0] * (len(_BUCKET_BOUNDS) + 1)
 
     def observe(self, seconds: float) -> None:
         self.count += 1
@@ -99,6 +154,31 @@ class _TimerStat:
         self.min = min(self.min, seconds)
         self.max = max(self.max, seconds)
         self.last = seconds
+        self.buckets[bisect.bisect_left(_BUCKET_BOUNDS, seconds)] += 1
+
+    def quantile(self, q: float) -> float:
+        """Histogram quantile with linear interpolation inside the
+        landing bucket, clamped to the observed [min, max] — error is
+        bounded by one bucket's width (ratio √2)."""
+        if not self.count:
+            return 0.0
+        rank = q * (self.count - 1)
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            if not n:
+                continue
+            if rank < seen + n:
+                lo = _BUCKET_BOUNDS[i - 1] if i > 0 else 0.0
+                hi = (
+                    _BUCKET_BOUNDS[i]
+                    if i < len(_BUCKET_BOUNDS)
+                    else max(self.max, lo)
+                )
+                frac = (rank - seen + 1.0) / n
+                est = lo + (hi - lo) * min(1.0, frac)
+                return min(max(est, self.min), self.max)
+            seen += n
+        return self.max
 
     def to_json(self) -> dict:
         return {
@@ -108,6 +188,9 @@ class _TimerStat:
             "min_s": self.min if self.count else 0.0,
             "max_s": self.max,
             "last_s": self.last,
+            "p50_s": self.quantile(0.50),
+            "p95_s": self.quantile(0.95),
+            "p99_s": self.quantile(0.99),
         }
 
 
@@ -124,7 +207,8 @@ class Metrics:
             self._counters[name] = self._counters.get(name, 0.0) + value
 
     def set_gauge(self, name: str, value: float) -> None:
-        self._gauges[name] = float(value)
+        with self._lock:
+            self._gauges[name] = float(value)
 
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -149,3 +233,48 @@ class Metrics:
                 "gauges": dict(self._gauges),
                 "timers": {k: v.to_json() for k, v in self._timers.items()},
             }
+
+
+class LoopLagProbe:
+    """Event-loop scheduling-delay probe — the runtime complement to
+    batonlint BTL001. Arms ``call_later(interval)`` and measures how
+    late the callback actually fires: any synchronous work hogging the
+    loop (a blocking read, an un-thread-ed decode) shows up directly as
+    lag. Publishes both a gauge (latest lag) and a histogram (p95/p99
+    over the run) under ``loop_lag_s``."""
+
+    def __init__(
+        self,
+        metrics: Metrics,
+        interval: float = 0.25,
+        name: str = "loop_lag_s",
+    ) -> None:
+        self.metrics = metrics
+        self.interval = interval
+        self.name = name
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._handle: Optional[asyncio.TimerHandle] = None
+        self._expected = 0.0
+        self._running = False
+
+    def start(self, loop: Optional[asyncio.AbstractEventLoop] = None) -> None:
+        self._loop = loop if loop is not None else asyncio.get_running_loop()
+        self._running = True
+        self._arm()
+
+    def _arm(self) -> None:
+        self._expected = time.monotonic() + self.interval
+        self._handle = self._loop.call_later(self.interval, self._tick)
+
+    def _tick(self) -> None:
+        lag = max(0.0, time.monotonic() - self._expected)
+        self.metrics.set_gauge(self.name, lag)
+        self.metrics.observe(self.name, lag)
+        if self._running:
+            self._arm()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None

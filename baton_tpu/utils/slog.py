@@ -1,0 +1,110 @@
+"""Structured JSON logging + the per-round SLO record appender.
+
+Two small, dependency-free pieces:
+
+- :class:`JsonFormatter` / :func:`setup_json_logging` — one JSON object
+  per log line with ``trace_id``/``span_id`` correlation fields pulled
+  from the active tracing context (:mod:`baton_tpu.utils.tracing`), so
+  a grep for a round's trace id yields its logs across manager and
+  workers.
+- :class:`RoundsLog` — thread-safe appender for ``rounds.jsonl``, the
+  per-round SLO summary artifact (one JSON object per finished/aborted
+  round) that the ROADMAP's scenario harness consumes. Appends are a
+  few hundred bytes once per round; they are written inline under a
+  lock with an fsync-free flush.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from baton_tpu.utils import tracing
+
+_RESERVED = frozenset(
+    logging.LogRecord(
+        "", 0, "", 0, "", (), None
+    ).__dict__
+) | {"message", "asctime", "taskName"}
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line: ts, level, logger, msg, any ``extra``
+    fields, plus trace/span correlation from the active span context."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: Dict[str, Any] = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        ctx = tracing.current_context()
+        if ctx is not None:
+            out["trace_id"], out["span_id"] = ctx
+        for key, val in record.__dict__.items():
+            if key not in _RESERVED and not key.startswith("_"):
+                try:
+                    json.dumps(val)
+                except (TypeError, ValueError):
+                    val = repr(val)
+                out[key] = val
+        if record.exc_info and record.exc_info[0] is not None:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=repr)
+
+
+def setup_json_logging(
+    logger: Optional[logging.Logger] = None,
+    level: int = logging.INFO,
+    stream: Any = None,
+) -> logging.Handler:
+    """Attach a JSON-formatted stream handler (idempotent per logger:
+    an existing JsonFormatter handler is reused)."""
+    logger = logger if logger is not None else logging.getLogger("baton_tpu")
+    for handler in logger.handlers:
+        if isinstance(handler.formatter, JsonFormatter):
+            return handler
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(JsonFormatter())
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    return handler
+
+
+class RoundsLog:
+    """Append-only ``rounds.jsonl`` writer. Each record is one round's
+    SLO summary (see :meth:`baton_tpu.server.http_manager.Experiment`'s
+    ``_emit_slo_record`` for the schema); ``wall_ts`` is stamped here
+    so callers never race the clock."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def append(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(
+            dict(record, wall_ts=round(time.time(), 6)), default=repr
+        )
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(line + "\n")
+
+    def read_all(self) -> list:
+        """Parse every record back (test/harness convenience)."""
+        out = []
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line:
+                        out.append(json.loads(line))
+        except OSError:
+            pass
+        return out

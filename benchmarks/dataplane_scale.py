@@ -70,6 +70,18 @@ from baton_tpu.server.state import (  # noqa: E402
     params_to_state_dict,
     state_dict_to_params,
 )
+from baton_tpu.utils.metrics import LoopLagProbe, Metrics  # noqa: E402
+
+
+def _timer_stats(metrics: Metrics, name: str) -> dict:
+    """p50/p95 + count for one histogram timer (PR 6: latency
+    percentiles come from the shared fixed-bucket histograms, not
+    ad-hoc sorted-list math — same quantile code as ``/metrics``)."""
+    st = metrics.snapshot()["timers"].get(name)
+    if st is None:
+        return {"p50_s": None, "p95_s": None, "count": 0, "max_s": None}
+    return {"p50_s": st["p50_s"], "p95_s": st["p95_s"],
+            "count": st["count"], "max_s": st["max_s"]}
 
 
 def _free_port() -> int:
@@ -150,6 +162,9 @@ async def _one_cohort(c: int, dim: int, rounds: int, delta_spec) -> dict:
     import aiohttp
 
     per_round = []
+    bench = Metrics()
+    lag_probe = LoopLagProbe(bench, interval=0.05)
+    lag_probe.start()
     timeout = aiohttp.ClientTimeout(total=600.0)
     async with aiohttp.ClientSession(timeout=timeout) as session:
         for r in range(rounds):
@@ -169,10 +184,13 @@ async def _one_cohort(c: int, dim: int, rounds: int, delta_spec) -> dict:
             _, agg_peak = tracemalloc.get_traced_memory()
             tracemalloc.stop()
             after = exp.metrics.snapshot()["counters"]
-            lat = sorted(t - t0 for t in ack_log)
-
-            def pct(xs, q):
-                return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else None
+            # one fresh histogram per round: the JSON keys stay
+            # per-round, but the quantiles come from the shared
+            # fixed-bucket implementation
+            round_hist = Metrics()
+            for t in ack_log:
+                round_hist.observe("notify_ack_s", t - t0)
+            ack_stats = _timer_stats(round_hist, "notify_ack_s")
 
             per_round.append({
                 "round": r,
@@ -186,9 +204,9 @@ async def _one_cohort(c: int, dim: int, rounds: int, delta_spec) -> dict:
                 - before.get("blob_hits_delta", 0.0),
                 "range_resumes": after.get("range_resumes", 0.0)
                 - before.get("range_resumes", 0.0),
-                "acks": len(lat),
-                "notify_ack_p50_s": pct(lat, 0.50),
-                "notify_ack_p95_s": pct(lat, 0.95),
+                "acks": ack_stats["count"],
+                "notify_ack_p50_s": ack_stats["p50_s"],
+                "notify_ack_p95_s": ack_stats["p95_s"],
                 "round_wall_s": time.perf_counter() - t0,
                 "manager_round_python_peak_bytes": agg_peak,
             })
@@ -198,6 +216,7 @@ async def _one_cohort(c: int, dim: int, rounds: int, delta_spec) -> dict:
                   f" p95={per_round[-1]['notify_ack_p95_s']:.3f}s",
                   file=sys.stderr, flush=True)
 
+    lag_probe.stop()
     for r in runners:
         await r.cleanup()
 
@@ -205,6 +224,7 @@ async def _one_cohort(c: int, dim: int, rounds: int, delta_spec) -> dict:
     steady = per_round[1:] or per_round
     mean_down = sum(p["bytes_down"] for p in steady) / len(steady)
     push_equiv = float(c * full_size)
+    lag = _timer_stats(bench, "loop_lag_s")
     return {
         "cohort": c,
         "model_dim": dim,
@@ -212,13 +232,10 @@ async def _one_cohort(c: int, dim: int, rounds: int, delta_spec) -> dict:
         "push_equiv_bytes_per_round": push_equiv,
         "steady_bytes_down_per_round": mean_down,
         "downlink_reduction_x": push_equiv / max(mean_down, 1.0),
+        "loop_lag_p95_s": lag["p95_s"],
+        "loop_lag_max_s": lag["max_s"],
         "rounds": per_round,
     }
-
-
-def _pct(xs, q):
-    xs = sorted(xs)
-    return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else None
 
 
 async def _uplink_once(
@@ -253,7 +270,13 @@ async def _uplink_once(
 
     rng = np.random.default_rng(0)
     template = params_to_state_dict(exp.params)
-    hb_lat, ack_lat, walls = [], [], []
+    # probe + ack latencies land in histogram timers; the event-loop
+    # lag probe runs through every burst — its max IS the worst stall
+    # the inline/pipelined ingest imposed on the loop
+    bench = Metrics()
+    lag_probe = LoopLagProbe(bench, interval=0.05)
+    lag_probe.start()
+    walls = []
     total_mb = 0.0
     for burst in range(bursts):
         round_name = exp.rounds.start_round(n_epoch=1)
@@ -281,23 +304,21 @@ async def _uplink_once(
             hb_json = {"client_id": creds[0]["client_id"],
                        "key": creds[0]["key"]}
             while not stop.is_set():
-                t0 = time.perf_counter()
-                async with session.get(
-                    f"{base}/heartbeat", json=hb_json
-                ) as r:
-                    assert r.status == 200
-                hb_lat.append(time.perf_counter() - t0)
+                with bench.timer("heartbeat_s"):
+                    async with session.get(
+                        f"{base}/heartbeat", json=hb_json
+                    ) as r:
+                        assert r.status == 200
                 await asyncio.sleep(0.003)
 
         async def post_one(cr, body):
-            t0 = time.perf_counter()
-            async with session.post(
-                f"{base}/update?client_id={cr['client_id']}"
-                f"&key={cr['key']}",
-                data=body, headers={"Content-Type": wire.CONTENT_TYPE},
-            ) as resp:
-                assert resp.status == 200, await resp.text()
-            ack_lat.append(time.perf_counter() - t0)
+            with bench.timer("ack_s"):
+                async with session.post(
+                    f"{base}/update?client_id={cr['client_id']}"
+                    f"&key={cr['key']}",
+                    data=body, headers={"Content-Type": wire.CONTENT_TYPE},
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
 
         probe_task = asyncio.ensure_future(probe())
         t0 = time.perf_counter()
@@ -311,20 +332,26 @@ async def _uplink_once(
     snap = exp.metrics.snapshot()["counters"]
     assert snap.get("updates_received", 0) == c * bursts
     assert snap.get("ingest_rejected_429", 0) == 0
+    lag_probe.stop()
     await session.close()
     await mrunner.cleanup()
     wall = sum(walls)
+    hb = _timer_stats(bench, "heartbeat_s")
+    ack = _timer_stats(bench, "ack_s")
+    lag = _timer_stats(bench, "loop_lag_s")
     return {
         "ingest_workers": ingest_workers,
         "bursts": bursts,
         "updates_per_s": c * bursts / wall,
         "uplink_mb_per_s": total_mb / wall,
         "burst_wall_s": wall / bursts,
-        "heartbeat_p50_s": _pct(hb_lat, 0.50),
-        "heartbeat_p95_s": _pct(hb_lat, 0.95),
-        "heartbeat_samples": len(hb_lat),
-        "ack_p50_s": _pct(ack_lat, 0.50),
-        "ack_p95_s": _pct(ack_lat, 0.95),
+        "heartbeat_p50_s": hb["p50_s"],
+        "heartbeat_p95_s": hb["p95_s"],
+        "heartbeat_samples": hb["count"],
+        "ack_p50_s": ack["p50_s"],
+        "ack_p95_s": ack["p95_s"],
+        "loop_lag_p95_s": lag["p95_s"],
+        "loop_lag_max_s": lag["max_s"],
     }
 
 
@@ -407,6 +434,9 @@ async def _resume_section(resume_mb: int, chunk_mb: int) -> dict:
           f"killing at offset {kill_offset} "
           f"({100 * kill_offset / total:.0f}%)...",
           file=sys.stderr, flush=True)
+    bench = Metrics()
+    lag_probe = LoopLagProbe(bench, interval=0.05)
+    lag_probe.start()
     t0 = time.perf_counter()
     status, _ = await w1._post_update_chunked(p)
     first_wall = time.perf_counter() - t0
@@ -426,6 +456,8 @@ async def _resume_section(resume_mb: int, chunk_mb: int) -> dict:
     def _ctr(w, name):
         return w.metrics.snapshot()["counters"].get(name, 0.0)
 
+    lag_probe.stop()
+    lag = _timer_stats(bench, "loop_lag_s")
     put_total = _ctr(w1, "chunk_bytes_put") + _ctr(w2, "chunk_bytes_put")
     retransfer = (put_total - total) / total
     out = {
@@ -439,6 +471,8 @@ async def _resume_section(resume_mb: int, chunk_mb: int) -> dict:
         "retransfer_fraction": retransfer,
         "first_attempt_wall_s": first_wall,
         "resume_wall_s": resume_wall,
+        "loop_lag_p95_s": lag["p95_s"],
+        "loop_lag_max_s": lag["max_s"],
         "assembled": exp.metrics.snapshot()["counters"].get(
             "chunked_uploads_assembled", 0.0),
     }

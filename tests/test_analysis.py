@@ -663,6 +663,168 @@ def test_btl030_disabled_without_registry():
     assert findings == []
 
 
+# dict registries carry timer/gauge name sets alongside the counters;
+# legacy 2-tuple registries (above) audit counters only
+DICT_REGISTRY = {
+    "counters": frozenset({"updates_received"}),
+    "counter_prefixes": ("updates_abandoned_",),
+    "timers": frozenset({"round_s"}),
+    "gauges": frozenset({"outbox_pending"}),
+}
+
+
+def test_btl030_timer_and_gauge_typos_flagged():
+    findings = lint(
+        """
+        def f(m, dt):
+            m.observe("round_z", dt)
+            with m.timer("round_s"):
+                pass
+            m.set_gauge("outbox_pendign", 1)
+        """,
+        rules=["BTL030"],
+        registry=DICT_REGISTRY,
+    )
+    assert len(findings) == 2
+    assert "round_z" in findings[0].message
+    assert "DECLARED_TIMERS" in findings[0].message
+    assert "outbox_pendign" in findings[1].message
+    assert "DECLARED_GAUGES" in findings[1].message
+
+
+def test_btl030_declared_timers_gauges_and_dynamic_names_pass():
+    findings = lint(
+        """
+        def f(m, dt, name):
+            m.observe("round_s", dt)
+            m.set_gauge("outbox_pending", 0)
+            m.observe(name, dt)  # dynamic: not checkable
+            m.inc("updates_received")
+        """,
+        rules=["BTL030"],
+        registry=DICT_REGISTRY,
+    )
+    assert findings == []
+
+
+def test_btl030_legacy_tuple_registry_skips_timer_gauge_audit():
+    # a 2-tuple registry predates DECLARED_TIMERS/DECLARED_GAUGES:
+    # timer/gauge names are unknown, so they must not be flagged
+    findings = lint(
+        """
+        def f(m, dt):
+            m.observe("whatever_s", dt)
+            m.set_gauge("whatever", 1)
+        """,
+        rules=["BTL030"],
+        registry=REGISTRY,
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# BTL031 — span hygiene (closure on all paths + traceparent forwarding)
+
+
+def test_btl031_manual_span_without_finally_flagged():
+    findings = lint(
+        """
+        async def f(self):
+            sp = self.tracer.start_span("broadcast")
+            await do_work()
+            sp.end()
+        """,
+        rules=["BTL031"],
+    )
+    assert len(findings) == 1
+    assert "not closed on all paths" in findings[0].message
+
+
+def test_btl031_manual_span_with_finally_passes():
+    findings = lint(
+        """
+        async def f(self):
+            sp = self.tracer.start_span("broadcast")
+            try:
+                await do_work()
+            finally:
+                sp.end()
+        """,
+        rules=["BTL031"],
+    )
+    assert findings == []
+
+
+def test_btl031_with_span_needs_no_manual_end():
+    findings = lint(
+        """
+        async def f(self):
+            with self.tracer.span("broadcast"):
+                await do_work()
+        """,
+        rules=["BTL031"],
+    )
+    assert findings == []
+
+
+def test_btl031_session_call_under_span_without_trace_headers():
+    findings = lint(
+        """
+        async def f(self, url, body):
+            with self.tracer.span("notify"):
+                async with self._session.post(url, data=body) as resp:
+                    return resp.status
+        """,
+        rules=["BTL031"],
+    )
+    assert len(findings) == 1
+    assert "traceparent" in findings[0].message
+
+
+def test_btl031_session_call_under_span_with_trace_headers_passes():
+    findings = lint(
+        """
+        async def f(self, url, body):
+            with self.tracer.span("notify"):
+                async with self._session.post(
+                    url, data=body, headers=trace_headers()
+                ) as resp:
+                    return resp.status
+
+        async def g(self, url):
+            with self.tracer.span("fetch"):
+                headers = trace_headers()
+                headers["Range"] = "bytes=0-"
+                async with self._session.get(url, headers=headers) as resp:
+                    return await resp.read()
+        """,
+        rules=["BTL031"],
+    )
+    assert findings == []
+
+
+def test_btl031_session_call_outside_span_unconstrained():
+    findings = lint(
+        """
+        async def f(self, url):
+            async with self._session.get(url) as resp:
+                return resp.status
+        """,
+        rules=["BTL031"],
+    )
+    assert findings == []
+
+
+def test_btl031_scoped_to_server_paths():
+    src = """
+    async def f(self, url):
+        with self.tracer.span("x"):
+            await self._session.get(url)
+    """
+    assert lint(src, rules=["BTL031"]) != []
+    assert lint(src, path="baton_tpu/core/fixture.py", rules=["BTL031"]) == []
+
+
 # ----------------------------------------------------------------------
 # suppressions
 
@@ -724,6 +886,7 @@ def test_all_rules_table():
     table = all_rules()
     assert set(table) == {
         "BTL001", "BTL002", "BTL003", "BTL010", "BTL020", "BTL030",
+        "BTL031",
     }
     assert all(table.values())
 

@@ -1,0 +1,541 @@
+"""Distributed round tracing, quantile metrics, and SLO records.
+
+Covers the PR 6 observability subsystem end to end:
+
+* ``traceparent`` propagation through a FULL round — broadcast → blob
+  fetch → local train → (chunked, 429-backpressured) upload → ingest →
+  aggregate — lands every participant's spans in ONE trace served by
+  ``GET /{name}/rounds/{rid}/trace`` as Chrome ``trace_event`` JSON;
+* span closure on every exit path (the BTL031 runtime contract);
+* fixed-bucket histogram quantiles against numpy within one bucket's
+  width (ratio √2);
+* the event-loop lag probe under a deliberate loop block;
+* the per-round SLO record appended to ``rounds.jsonl``;
+* chaos: a manager killed and rebuilt MID-ROUND exports one trace whose
+  spans name BOTH manager incarnations and at least one worker, with
+  the recovery re-broadcast visibly after the first incarnation's last
+  span (the recovery gap).
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from baton_tpu.core.training import make_local_trainer
+from baton_tpu.data.synthetic import linear_client_data
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.server.http_manager import Manager
+from baton_tpu.server.http_worker import ExperimentWorker
+from baton_tpu.utils import tracing
+from baton_tpu.utils.faults import FaultInjector
+from baton_tpu.utils.metrics import _BUCKET_RATIO, LoopLagProbe, Metrics
+from baton_tpu.utils.slog import JsonFormatter, RoundsLog
+from baton_tpu.utils.tracing import Tracer
+
+from test_http_protocol import free_port
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _wait(cond, n=600, dt=0.05):
+    for _ in range(n):
+        if cond():
+            return True
+        await asyncio.sleep(dt)
+    return cond()
+
+
+# ----------------------------------------------------------------------
+# traceparent + span primitives
+
+
+def test_traceparent_roundtrip_and_rejects():
+    tid, sid = tracing.make_trace_id("exp", "update_exp_00000"), \
+        tracing.make_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    assert tracing.parse_traceparent(
+        tracing.format_traceparent(tid, sid)) == (tid, sid)
+    # deterministic: every party derives the same ids independently
+    assert tid == tracing.make_trace_id("exp", "update_exp_00000")
+    assert tracing.root_span_id(tid) == tracing.root_span_id(tid)
+    for bad in (None, "", "junk", "00-short-short-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",
+                "00-" + "g" * 32 + "-" + "1" * 16 + "-01"):
+        assert tracing.parse_traceparent(bad) is None
+
+
+def test_span_closed_on_exception_and_context_reset():
+    tr = Tracer(service="t")
+    assert tracing.current_context() is None
+    with pytest.raises(ValueError):
+        with tr.span("boom", trace_id="a" * 32):
+            assert tracing.current_context() is not None
+            raise ValueError("x")
+    # the span was ended (recorded) and the context restored
+    assert tracing.current_context() is None
+    spans = tr.spans_for("a" * 32)
+    assert len(spans) == 1
+    assert spans[0]["args"]["error"] == "ValueError"
+    assert spans[0]["end"] >= spans[0]["start"]
+
+
+def test_trace_headers_only_under_active_span():
+    assert "traceparent" not in tracing.trace_headers({"X": "1"})
+    tr = Tracer(service="t")
+    with tr.span("s", trace_id="b" * 32) as sp:
+        hdrs = tracing.trace_headers({"Content-Type": "x"})
+        assert hdrs["Content-Type"] == "x"
+        assert tracing.parse_traceparent(hdrs["traceparent"]) == \
+            ("b" * 32, sp.span_id)
+
+
+def test_export_is_chrome_trace_event_json():
+    tr = Tracer(service="svc_a")
+    tid = "c" * 32
+    with tr.span("parent", trace_id=tid):
+        with tr.span("child"):
+            pass
+    tr.ingest([{
+        "trace_id": tid, "span_id": "d" * 16, "name": "remote",
+        "service": "svc_b", "start": 1.0, "end": 2.0,
+    }])
+    doc = tr.export(tid)
+    assert json.loads(json.dumps(doc)) == doc  # serializable as-is
+    events = doc["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    slices = [e for e in events if e["ph"] == "X"]
+    assert {m["args"]["name"] for m in meta} == {"svc_a", "svc_b"}
+    assert len(slices) == 3
+    for e in slices:
+        assert set(e) >= {"ph", "ts", "dur", "pid", "tid", "name"}
+        assert e["dur"] >= 0.0
+    # the child parent-links to the enclosing span via the contextvar
+    by_name = {e["name"]: e for e in slices}
+    assert by_name["child"]["args"]["parent_id"] == \
+        by_name["parent"]["args"]["span_id"]
+
+
+def test_tracer_spool_survives_heap_loss(tmp_path):
+    tid = tracing.make_trace_id("e", "r")
+    t1 = Tracer(service="incarnation_a", spool_dir=str(tmp_path))
+    with t1.span("first_life", trace_id=tid):
+        pass
+    del t1  # the "crash": heap gone, spool remains
+    t2 = Tracer(service="incarnation_b", spool_dir=str(tmp_path))
+    with t2.span("second_life", trace_id=tid):
+        pass
+    names = {s["name"] for s in t2.spans_for(tid)}
+    assert names == {"first_life", "second_life"}
+    services = {s["service"] for s in t2.spans_for(tid)}
+    assert services == {"incarnation_a", "incarnation_b"}
+
+
+def test_ingest_drops_malformed_keeps_valid():
+    tr = Tracer(service="m")
+    n = tr.ingest([
+        "not a dict",
+        {"trace_id": "x"},  # missing fields
+        {"trace_id": "e" * 32, "span_id": "bad", "name": "n",
+         "start": 0, "end": 1},  # bad span id length
+        {"trace_id": "e" * 32, "span_id": "f" * 16, "name": "ok",
+         "start": 0.5, "end": 1.5},
+    ])
+    assert n == 1
+    assert [s["name"] for s in tr.spans_for("e" * 32)] == ["ok"]
+
+
+# ----------------------------------------------------------------------
+# histogram quantiles + loop lag
+
+
+def test_histogram_quantiles_match_numpy_within_bucket(nprng):
+    m = Metrics()
+    samples = np.abs(nprng.lognormal(mean=-3.0, sigma=1.2, size=4000))
+    for s in samples:
+        m.observe("round_s", float(s))
+    stats = m.snapshot()["timers"]["round_s"]
+    for q, key in ((0.5, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s")):
+        true = float(np.quantile(samples, q))
+        est = stats[key]
+        # bounded error: one log-spaced bucket's width (ratio sqrt(2))
+        assert true / (_BUCKET_RATIO * 1.05) <= est <= \
+            true * _BUCKET_RATIO * 1.05, (key, est, true)
+    assert stats["count"] == 4000
+    assert stats["min_s"] <= stats["p50_s"] <= stats["p95_s"] \
+        <= stats["p99_s"] <= stats["max_s"]
+
+
+def test_histogram_empty_and_single_observation():
+    m = Metrics()
+    m.observe("checkpoint_s", 0.1)
+    st = m.snapshot()["timers"]["checkpoint_s"]
+    assert st["p50_s"] == st["p95_s"] == st["p99_s"] == \
+        pytest.approx(0.1)
+
+
+def test_loop_lag_probe_sees_deliberate_block():
+    async def main():
+        m = Metrics()
+        probe = LoopLagProbe(m, interval=0.05)
+        probe.start()
+        await asyncio.sleep(0.12)  # a few clean ticks first
+        time.sleep(0.3)  # deliberately hog the loop
+        await asyncio.sleep(0.12)  # let the late tick fire + recover
+        probe.stop()
+        snap = m.snapshot()
+        assert snap["timers"]["loop_lag_s"]["max_s"] >= 0.2
+        assert "loop_lag_s" in snap["gauges"]
+
+    run(main())
+
+
+# ----------------------------------------------------------------------
+# structured logging
+
+
+def test_json_formatter_carries_trace_context():
+    import logging
+
+    rec = logging.LogRecord("l", logging.INFO, "f.py", 1, "hello %s",
+                            ("world",), None)
+    rec.extra_field = {"k": 1}
+    tr = Tracer(service="t")
+    with tr.span("s", trace_id="f" * 32) as sp:
+        line = json.loads(JsonFormatter().format(rec))
+    assert line["msg"] == "hello world"
+    assert line["trace_id"] == "f" * 32
+    assert line["span_id"] == sp.span_id
+    assert line["extra_field"] == {"k": 1}
+    # outside a span: no correlation fields, still valid JSON
+    line = json.loads(JsonFormatter().format(rec))
+    assert "trace_id" not in line
+
+
+def test_rounds_log_append_and_read(tmp_path):
+    path = str(tmp_path / "nested" / "rounds.jsonl")
+    log = RoundsLog(path)
+    log.append({"round": "r1", "outcome": "completed"})
+    log.append({"round": "r2", "outcome": "aborted:test"})
+    records = log.read_all()
+    assert [r["round"] for r in records] == ["r1", "r2"]
+    assert all("wall_ts" in r for r in records)
+
+
+# ----------------------------------------------------------------------
+# e2e: one distributed round = one trace
+
+
+async def _start_manager(name, mport, inj=None, **exp_kwargs):
+    model = linear_regression_model(10)
+    middlewares = [inj.middleware] if inj is not None else []
+    mapp = web.Application(middlewares=middlewares)
+    exp = Manager(mapp).register_experiment(model, name=name, **exp_kwargs)
+    mrunner = web.AppRunner(mapp)
+    await mrunner.setup()
+    await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+    return exp, mrunner
+
+
+async def _start_workers(name, mport, n_workers, trainer, **worker_kwargs):
+    model = linear_regression_model(10)
+    nprng = np.random.default_rng(3)
+    workers, runners = [], []
+    for _ in range(n_workers):
+        wport = free_port()
+        data = linear_client_data(nprng, min_batches=2, max_batches=2)
+        wapp = web.Application()
+        w = ExperimentWorker(
+            wapp, model, f"127.0.0.1:{mport}",
+            name=name, port=wport, heartbeat_time=0.5,
+            trainer=trainer,
+            get_data=lambda d=data: (d, d["x"].shape[0]),
+            outbox_backoff=(0.05, 0.4),
+            **worker_kwargs,
+        )
+        wrunner = web.AppRunner(wapp)
+        await wrunner.setup()
+        await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+        workers.append(w)
+        runners.append(wrunner)
+    return workers, runners
+
+
+async def _start_round(mport, name, n_epoch=2):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        async with session.get(
+            f"http://127.0.0.1:{mport}/{name}/start_round?n_epoch={n_epoch}"
+        ) as resp:
+            assert resp.status == 200
+            return await resp.json()
+
+
+async def _get_json(mport, path):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        async with session.get(f"http://127.0.0.1:{mport}{path}") as resp:
+            return resp.status, await resp.json()
+
+
+def test_full_round_trace_chunked_upload_and_429(tmp_path):
+    """One round with a chunk-uploading worker whose first PUT is
+    429-refused and a plain worker whose first POST is 429-refused: the
+    trace endpoint still serves ONE trace containing manager AND worker
+    spans, the ingest span parented by the worker's upload span, and
+    rounds.jsonl gets a completed SLO record."""
+
+    async def main():
+        inj = FaultInjector()
+        name, mport = "trc", free_port()
+        trace_dir = str(tmp_path / "traces")
+        rounds_path = str(tmp_path / "rounds.jsonl")
+        exp, mrunner = await _start_manager(
+            name, mport, inj=inj,
+            trace_dir=trace_dir, rounds_log_path=rounds_path,
+        )
+        trainer = make_local_trainer(linear_regression_model(10),
+                                     batch_size=32, learning_rate=0.02)
+        workers, wrunners = await _start_workers(name, mport, 1, trainer)
+        chunked, crunners = await _start_workers(
+            name, mport, 1, trainer, upload_chunk_bytes=256,
+        )
+        workers, wrunners = workers + chunked, wrunners + crunners
+        assert await _wait(lambda: len(exp.registry) == 2)
+
+        # first upload attempt on each path is backpressured: the
+        # traceparent must survive the outbox retry
+        inj.error(f"/{name}/update?", status=429, times=1)
+        inj.error("offset=", status=429, times=1)
+        acks = await _start_round(mport, name)
+        assert sum(acks.values()) == 2
+        assert await _wait(lambda: exp.rounds.n_rounds == 1)
+
+        # worker spans arrive via the fire-and-forget upstream ship
+        assert await _wait(lambda: all(
+            w.metrics.snapshot()["counters"].get("trace_spans_shipped", 0)
+            for w in workers
+        ))
+        for w in workers:
+            assert w.metrics.snapshot()["counters"]["update_retries"] >= 1
+
+        status, doc = await _get_json(mport, f"/{name}/rounds/0/trace")
+        assert status == 200
+        slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        services = {
+            e["args"]["name"]
+            for e in doc["traceEvents"] if e["ph"] == "M"
+        }
+        assert any(s.startswith("manager#") for s in services)
+        worker_services = {s for s in services if s.startswith("worker:")}
+        assert {f"worker:{w.client_id}" for w in workers} == worker_services
+
+        names = {e["name"] for e in slices}
+        assert {"round", "round_setup", "notify", "ingest", "upload",
+                "local_train", "aggregate"} <= names
+        # all slices are one trace: ingest spans are parented by the
+        # worker upload spans whose traceparent rode the HTTP call
+        upload_ids = {
+            e["args"]["span_id"] for e in slices if e["name"] == "upload"
+        }
+        ingests = [e for e in slices if e["name"] == "ingest"]
+        assert len(ingests) == 2
+        assert all(e["args"]["parent_id"] in upload_ids for e in ingests)
+        assert any(e["args"].get("chunked") for e in ingests)
+        # phase spans parent-link to the retroactively-emitted root
+        root = next(e for e in slices if e["name"] == "round")
+        tid = tracing.make_trace_id(name, "update_%s_%05d" % (name, 0))
+        assert root["args"]["span_id"] == tracing.root_span_id(tid)
+        setup = next(e for e in slices if e["name"] == "round_setup")
+        assert setup["args"]["parent_id"] == root["args"]["span_id"]
+
+        # unknown round -> 404
+        status, _ = await _get_json(mport, f"/{name}/rounds/7/trace")
+        assert status == 404
+
+        # SLO record
+        rec = RoundsLog(rounds_path).read_all()
+        assert len(rec) == 1 and rec[0]["outcome"] == "completed"
+        assert rec[0]["round"] == "update_%s_%05d" % (name, 0)
+        assert rec[0]["trace_id"] == tid
+        assert rec[0]["participants"] == 2 and rec[0]["reporters"] == 2
+        assert rec[0]["stragglers"] == []
+        assert rec[0]["bytes_uploaded"] > 0
+        assert "broadcast" in rec[0]["phase_s"]
+        assert rec[0]["duration_s"] >= rec[0]["phase_s"]["broadcast"] - 0.5
+
+        # every former timer now reports quantiles on /metrics
+        status, snap = await _get_json(mport, f"/{name}/metrics")
+        assert status == 200
+        for tname, st in snap["timers"].items():
+            assert {"p50_s", "p95_s", "p99_s"} <= set(st), tname
+        assert "round_s" in snap["timers"]
+        assert "notify_s" in snap["timers"]
+        assert snap["counters"]["trace_spans_ingested"] > 0
+        # heartbeats run on a 0.5 s period: the worker histogram has them
+        assert await _wait(lambda: (
+            "heartbeat_s"
+            in workers[0].metrics.snapshot()["timers"]
+        ))
+
+        for r in [mrunner] + wrunners:
+            await r.cleanup()
+
+    run(main())
+
+
+def test_trace_spans_endpoint_auth_and_validation():
+    async def main():
+        app = web.Application()
+        exp = Manager(app).register_experiment(
+            linear_regression_model(4), name="ts",
+            start_background_tasks=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+
+        resp = await client.post("/ts/trace_spans", json=[])
+        assert resp.status == 401
+
+        reg = await (await client.get("/ts/register",
+                                      json={"port": 1})).json()
+        auth = f"?client_id={reg['client_id']}&key={reg['key']}"
+        resp = await client.post(f"/ts/trace_spans{auth}",
+                                 json={"nonsense": 1})
+        assert resp.status == 400
+        good = {"trace_id": "a" * 32, "span_id": "b" * 16, "name": "n",
+                "start": 1.0, "end": 2.0}
+        resp = await client.post(f"/ts/trace_spans{auth}",
+                                 json=[good, {"malformed": True}])
+        assert resp.status == 200
+        assert (await resp.json())["accepted"] == 1
+        snap = exp.metrics.snapshot()["counters"]
+        assert snap["trace_spans_ingested"] == 1
+        assert snap["trace_spans_rejected"] == 1
+        await client.close()
+
+    run(main())
+
+
+# ----------------------------------------------------------------------
+# chaos: the trace survives a manager kill + recovery
+
+
+def test_trace_spans_both_manager_incarnations_and_recovery_gap(tmp_path):
+    """Manager A dies mid-round (updates 503-refused, workers parked);
+    manager B resumes the round from the journal. The exported trace —
+    served by B — shows A's broadcast-phase spans, B's recovery
+    re-broadcast strictly after A's last span (the recovery gap), and a
+    worker's spans; rounds.jsonl records the completed resume."""
+
+    async def main():
+        import aiohttp
+
+        name = "ctr"
+        journal_path = str(tmp_path / "wal.jsonl")
+        trace_dir = str(tmp_path / "traces")
+        rounds_path = str(tmp_path / "rounds.jsonl")
+        inj = FaultInjector()
+        mport = free_port()
+        exp_a, mrunner_a = await _start_manager(
+            name, mport, inj=inj, journal_path=journal_path,
+            recovery_policy="resume", trace_dir=trace_dir,
+            rounds_log_path=rounds_path,
+        )
+        trainer = make_local_trainer(linear_regression_model(10),
+                                     batch_size=32, learning_rate=0.02)
+        workers, wrunners = await _start_workers(name, mport, 2, trainer)
+        assert await _wait(lambda: len(exp_a.registry) == 2)
+
+        await _start_round(mport, name)  # clean warm-up round
+        assert await _wait(lambda: exp_a.rounds.n_rounds == 1)
+
+        inj.error(f"/{name}/update", status=503)
+        await _start_round(mport, name)
+        crashed_round = exp_a.rounds.round_name
+        service_a = exp_a.tracer.service
+        assert await _wait(
+            lambda: all(not w.round_in_progress for w in workers)
+            and all(w._pending is not None for w in workers)
+        )
+        assert exp_a.rounds.in_progress
+        await mrunner_a.cleanup()  # the crash
+        crash_time = time.time()
+
+        exp_b, mrunner_b = await _start_manager(
+            name, mport, journal_path=journal_path,
+            recovery_policy="resume", trace_dir=trace_dir,
+            rounds_log_path=rounds_path,
+        )
+        service_b = exp_b.tracer.service
+        assert service_a != service_b
+        assert await _wait(lambda: exp_b.rounds.n_rounds == 2, n=900)
+        assert await _wait(lambda: any(
+            w.metrics.snapshot()["counters"].get("trace_spans_shipped", 0)
+            for w in workers
+        ))
+
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                f"http://127.0.0.1:{mport}/{name}/rounds/1/trace"
+            ) as resp:
+                assert resp.status == 200
+                doc = await resp.json()
+
+        # Perfetto-loadable: well-formed trace_event JSON
+        assert isinstance(doc["traceEvents"], list)
+        slices = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert all(
+            set(e) >= {"ph", "ts", "dur", "pid", "tid", "name"}
+            for e in slices
+        )
+        services = {
+            e["args"]["name"]
+            for e in doc["traceEvents"] if e["ph"] == "M"
+        }
+        # both incarnations AND at least one worker are in ONE trace
+        assert service_a in services and service_b in services
+        assert any(s.startswith("worker:") for s in services)
+
+        by_service = {}
+        for e in slices:
+            svc = next(
+                m["args"]["name"] for m in doc["traceEvents"]
+                if m["ph"] == "M" and m["pid"] == e["pid"]
+            )
+            by_service.setdefault(svc, []).append(e)
+        # incarnation A recorded the original broadcast phase...
+        assert any(e["name"] == "notify" for e in by_service[service_a])
+        # ...incarnation B re-announced, visibly AFTER the crash: the
+        # recovery gap separates the two incarnations' span clusters
+        rebroadcasts = [
+            e for e in by_service[service_b]
+            if e["name"] == "recovery_rebroadcast"
+        ]
+        assert len(rebroadcasts) == 1
+        a_last_end_us = max(
+            e["ts"] + e["dur"] for e in by_service[service_a]
+            if e["name"] != "round"
+        )
+        assert rebroadcasts[0]["ts"] >= a_last_end_us
+        assert rebroadcasts[0]["ts"] >= crash_time * 1e6
+
+        # the SLO log has the warm-up round (A) and the resumed round (B)
+        records = RoundsLog(rounds_path).read_all()
+        assert [r["outcome"] for r in records] == ["completed", "completed"]
+        assert records[1]["round"] == crashed_round
+        assert records[1]["service"] == service_b
+
+        for r in [mrunner_b] + wrunners:
+            await r.cleanup()
+
+    run(main())
