@@ -3,7 +3,8 @@
 Dashboards and the ops alert rules key on exact metric names; a typo
 at an ``metrics.inc("updates_recieved")`` call site silently forks the
 series and the alert never fires. Every counter name used under
-``server/`` must appear in ``DECLARED_COUNTERS`` (or match a prefix in
+``server/`` or ``loadgen/`` must appear in ``DECLARED_COUNTERS`` (or
+match a prefix in
 ``DECLARED_COUNTER_PREFIXES``, for families built with f-strings),
 every timer/histogram name observed via ``.observe()``/``.timer()`` in
 ``DECLARED_TIMERS``, and every gauge set via ``.set_gauge()`` in
@@ -63,7 +64,12 @@ class CounterRegistryChecker(Checker):
     title = "metric name not declared in utils/metrics.py registry"
 
     def applies_to(self, ctx: CheckContext) -> bool:
-        return "server" in ctx.parts and ctx.counter_registry is not None
+        # loadgen drives the server over HTTP and publishes its own
+        # scenario_* series into the same dashboards, so its call sites
+        # are audited against the same registry
+        return (
+            "server" in ctx.parts or "loadgen" in ctx.parts
+        ) and ctx.counter_registry is not None
 
     def check(self, ctx: CheckContext) -> Iterable[Finding]:
         reg = ctx.counter_registry

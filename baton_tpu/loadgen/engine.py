@@ -1,0 +1,473 @@
+"""Open-loop scenario driver: a real federation under synthetic traffic.
+
+The engine spins up one real manager and ``workers.count`` real workers
+— actual :class:`~baton_tpu.server.http_manager.Experiment` /
+:class:`~baton_tpu.server.http_worker.ExperimentWorker` instances on
+loopback sockets, nothing mocked — then plays the scenario's phases
+against them:
+
+- **Open-loop rounds.** ``GET start_round`` fires every
+  ``rounds.interval_s`` seconds of scenario time whether or not the
+  previous round finished. A busy manager answers 423 and the refusal
+  is *counted*, not retried — arrival rate is the independent variable,
+  exactly like production traffic, so overload shows up as a refusal
+  rate instead of being silently absorbed by a closed feedback loop.
+- **Availability.** Each tick computes the phase's curve level ``a`` and
+  marks the first ``round(a × alive)`` workers (by index) available.
+  Unavailable workers answer ``round_start`` with an injected 503 — the
+  same refusal a phone off-charger would send — which the manager
+  counts (``broadcast_rejected_503``) and excludes from the round
+  without evicting the client. Deterministic rank-based selection keeps
+  runs reproducible.
+- **Churn.** Leave/join rates accumulate per tick; a leave tears the
+  worker's HTTP server down cold (no deregister — the manager learns
+  via notify failures and the TTL cull), a join spawns a brand-new
+  worker mid-run. The fleet the SLOs see is never the fleet that
+  registered.
+- **Stragglers / device speeds.** ``workers.speeds`` maps fleet
+  fractions to ``train_time_scale`` multipliers; the manager's
+  ``round_timeout`` watchdog turns slow tails into recorded
+  ``stragglers`` in ``rounds.jsonl``.
+- **Faults.** Phase-scoped :class:`~baton_tpu.utils.faults.FaultInjector`
+  rules on the manager and/or every worker (delays, errors, connection
+  drops), removed when the phase ends.
+
+Warm-up rounds (XLA compile) run before the scenario clock starts with
+everything available and no faults; their round names are recorded so
+the SLO evaluator excludes them. Artifacts land in the run directory:
+``rounds.jsonl`` (written by the manager), ``manager_metrics.json``
+(the ``/metrics`` scrape), ``loadgen_metrics.json`` (driver counters),
+``scenario_summary.json`` (phase timeline + per-round annotations).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import os
+import random
+import socket
+import time
+from typing import List, Optional
+
+import numpy as np
+import aiohttp
+from aiohttp import web
+
+from baton_tpu.core.training import make_local_trainer
+from baton_tpu.data.synthetic import linear_client_data
+from baton_tpu.loadgen.scenario import PhaseSpec, Scenario
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.server.http_manager import Manager
+from baton_tpu.server.http_worker import ExperimentWorker
+from baton_tpu.utils.faults import FaultInjector, Rule
+from baton_tpu.utils.metrics import Metrics
+from baton_tpu.utils.slog import read_rounds_jsonl
+
+log = logging.getLogger("baton_tpu.loadgen")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _WorkerSlot:
+    """One simulated device: its worker, server runner, fault injector
+    (availability gate + phase faults), and the flags the ticker flips."""
+
+    __slots__ = ("idx", "worker", "runner", "injector", "available", "alive")
+
+    def __init__(self, idx: int, worker: ExperimentWorker,
+                 runner: web.AppRunner, injector: FaultInjector) -> None:
+        self.idx = idx
+        self.worker = worker
+        self.runner = runner
+        self.injector = injector
+        self.available = True
+        self.alive = True
+
+
+class ScenarioRunner:
+    """Drives one scenario end to end; :meth:`run` returns the summary
+    dict (also written to ``scenario_summary.json``)."""
+
+    def __init__(self, scenario: Scenario, artifacts_dir: str,
+                 tick_s: float = 0.1) -> None:
+        self.scenario = scenario
+        self.artifacts_dir = artifacts_dir
+        self.tick_s = tick_s
+        self.metrics = Metrics()
+        # one shared registry for every simulated worker: fleet-wide
+        # heartbeat/upload histograms instead of per-process islands
+        # (exported as worker_metrics.json, addressed as ``fleet:*``)
+        self.fleet_metrics = Metrics()
+        self.rounds_path = os.path.join(artifacts_dir, "rounds.jsonl")
+        self._rng = random.Random(scenario.seed)
+        self._nprng = np.random.default_rng(scenario.seed)
+        self._slots: List[_WorkerSlot] = []
+        self._next_idx = 0
+        self._leave_debt = 0.0
+        self._join_debt = 0.0
+        self._runners: List[web.AppRunner] = []
+        self._round_tasks: List[asyncio.Task] = []
+        self._phase_rules: List[tuple] = []   # (injector, Rule)
+        self._active_worker_faults: List = []  # FaultSpec, for joiners
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._exp = None
+        self._mport = 0
+        self._model = None
+        self._trainer = None
+        self._coef = None
+        self.warmup_round_names: List[str] = []
+        self.phase_log: List[dict] = []
+
+    # -- fleet ---------------------------------------------------------
+    async def _spawn_worker(self) -> _WorkerSlot:
+        scn = self.scenario
+        idx = self._next_idx
+        self._next_idx += 1
+        data = linear_client_data(
+            self._nprng,
+            coef=self._coef,
+            min_batches=scn.workers.min_batches,
+            max_batches=scn.workers.max_batches,
+            batch_size=scn.workers.batch_size,
+        )
+        inj = FaultInjector()
+        wapp = web.Application(middlewares=[inj.middleware])
+        worker = ExperimentWorker(
+            wapp, self._model, f"127.0.0.1:{self._mport}",
+            name=scn.name, port=_free_port(),
+            heartbeat_time=scn.workers.heartbeat_time,
+            trainer=self._trainer,
+            get_data=lambda d=data: (d, d["x"].shape[0]),
+            rng_seed=idx,
+            outbox_backoff=(0.05, 0.5),
+            upload_chunk_bytes=scn.workers.upload_chunk_bytes,
+            train_time_scale=scn.workers.speed_for(idx),
+        )
+        worker.metrics = self.fleet_metrics
+        runner = web.AppRunner(wapp)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", worker.port).start()
+        slot = _WorkerSlot(idx, worker, runner, inj)
+        # the availability gate: a standing 503 on round_start that only
+        # fires while the ticker has the slot marked unavailable — the
+        # manager counts the refusal and skips the worker WITHOUT
+        # evicting it (a 404 would force re-registration instead)
+        inj.error("round_start", status=503,
+                  gate=lambda s=slot: not s.available)
+        for fs in self._active_worker_faults:
+            self._install_fault(fs, inj, record=True)
+        self._slots.append(slot)
+        self._runners.append(runner)
+        return slot
+
+    async def _reap(self, slot: _WorkerSlot) -> None:
+        """Cancel a worker's background delivery tasks. A departed
+        worker's outbox would otherwise retry into its own closed
+        session forever."""
+        for task in (slot.worker._outbox_task, slot.worker._ship_task):
+            if task is not None and not task.done():
+                task.cancel()
+                with contextlib.suppress(Exception, asyncio.CancelledError):
+                    await task
+
+    async def _leave(self, slot: _WorkerSlot) -> None:
+        slot.alive = False
+        slot.available = False
+        with contextlib.suppress(Exception):
+            await slot.runner.cleanup()
+        await self._reap(slot)
+        self.metrics.inc("scenario_workers_left")
+
+    # -- faults --------------------------------------------------------
+    def _install_fault(self, fs, inj: FaultInjector,
+                       record: bool = False) -> Rule:
+        if fs.action == "error":
+            rule = inj.error(fs.match, status=fs.status, times=fs.times)
+        elif fs.action == "delay":
+            rule = inj.delay(fs.match, seconds=fs.delay_s, times=fs.times)
+        else:
+            rule = inj.drop(fs.match, times=fs.times)
+        if record:
+            self._phase_rules.append((inj, rule))
+        return rule
+
+    def _enter_phase(self, idx: int, phase: PhaseSpec, minj: FaultInjector,
+                     elapsed: float) -> None:
+        for inj, rule in self._phase_rules:
+            inj.remove(rule)
+        self._phase_rules.clear()
+        self._active_worker_faults = []
+        for fs in phase.faults:
+            if fs.target == "manager":
+                self._install_fault(fs, minj, record=True)
+            else:
+                self._active_worker_faults.append(fs)
+                for slot in self._slots:
+                    if slot.alive:
+                        self._install_fault(fs, slot.injector, record=True)
+        self.metrics.set_gauge("scenario_phase_index", idx)
+        self.phase_log.append({
+            "phase": phase.name, "index": idx,
+            "scenario_t": round(elapsed, 3), "wall_ts": None,  # stamped below
+        })
+        log.info("loadgen: entering phase %r (t=%.1fs, %d faults)",
+                 phase.name, elapsed, len(phase.faults))
+
+    # -- ticker pieces -------------------------------------------------
+    def _apply_availability(self, level: float) -> None:
+        alive = [s for s in self._slots if s.alive]
+        alive.sort(key=lambda s: s.idx)
+        k = int(round(level * len(alive)))
+        for rank, slot in enumerate(alive):
+            slot.available = rank < k
+        self.metrics.set_gauge("scenario_availability", level)
+        self.metrics.set_gauge("scenario_workers_available", k)
+        self.metrics.set_gauge("scenario_workers_alive", len(alive))
+
+    async def _apply_churn(self, phase: PhaseSpec, dt: float) -> None:
+        self._leave_debt += phase.churn.leave_per_s * dt
+        self._join_debt += phase.churn.join_per_s * dt
+        while self._leave_debt >= 1.0:
+            self._leave_debt -= 1.0
+            alive = [s for s in self._slots if s.alive]
+            if len(alive) <= 1:   # never churn the fleet to extinction
+                break
+            await self._leave(self._rng.choice(alive))
+        while self._join_debt >= 1.0:
+            self._join_debt -= 1.0
+            await self._spawn_worker()
+            self.metrics.inc("scenario_workers_joined")
+
+    async def _fire_round(self) -> None:
+        scn = self.scenario
+        url = (f"http://127.0.0.1:{self._mport}/{scn.name}/start_round"
+               f"?n_epoch={scn.rounds.n_epoch}")
+        try:
+            async with self._session.get(url) as resp:
+                await resp.read()
+                if resp.status == 200:
+                    self.metrics.inc("scenario_rounds_started")
+                elif resp.status == 423:
+                    self.metrics.inc("scenario_rounds_refused_423")
+                else:
+                    self.metrics.inc("scenario_start_round_errors")
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            self.metrics.inc("scenario_start_round_errors")
+
+    async def _wait(self, cond, timeout_s: float, dt: float = 0.05) -> bool:
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while asyncio.get_running_loop().time() < deadline:
+            if cond():
+                return True
+            await asyncio.sleep(dt)
+        return bool(cond())
+
+    # -- the run -------------------------------------------------------
+    async def run(self) -> dict:
+        scn = self.scenario
+        os.makedirs(self.artifacts_dir, exist_ok=True)
+        # a fresh run must not inherit a previous run's rounds
+        with contextlib.suppress(OSError):
+            os.remove(self.rounds_path)
+
+        self._model = linear_regression_model(scn.model_dim)
+        # ground-truth coefficients sized to the scenario's model (the
+        # synthetic-data default is a fixed 10-dim demo vector)
+        self._coef = self._nprng.standard_normal(scn.model_dim).astype(
+            np.float32
+        )
+        self._trainer = make_local_trainer(
+            linear_regression_model(scn.model_dim),
+            batch_size=scn.workers.batch_size,
+            learning_rate=scn.workers.learning_rate,
+        )
+        self._mport = _free_port()
+        minj = FaultInjector()
+        mapp = web.Application(middlewares=[minj.middleware])
+        self._exp = Manager(mapp).register_experiment(
+            self._model, name=scn.name,
+            round_timeout=scn.manager.round_timeout,
+            client_ttl=scn.manager.client_ttl,
+            cohort_fraction=scn.manager.cohort_fraction,
+            min_cohort=scn.manager.min_cohort,
+            ingest_workers=scn.manager.ingest_workers,
+            streaming_aggregation=scn.manager.streaming_aggregation,
+            rounds_log_path=self.rounds_path,
+        )
+        mrunner = web.AppRunner(mapp)
+        await mrunner.setup()
+        await web.TCPSite(mrunner, "127.0.0.1", self._mport).start()
+        self._runners.append(mrunner)
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=60)
+        )
+        try:
+            return await self._run_inner(minj)
+        finally:
+            await self._teardown()
+
+    async def _run_inner(self, minj: FaultInjector) -> dict:
+        scn = self.scenario
+        exp = self._exp
+
+        for _ in range(scn.workers.count):
+            await self._spawn_worker()
+        ok = await self._wait(
+            lambda: len(exp.registry) >= scn.workers.count, timeout_s=30.0
+        )
+        if not ok:
+            raise RuntimeError(
+                f"fleet failed to register: {len(exp.registry)}"
+                f"/{scn.workers.count} after 30s"
+            )
+
+        # warm-up: compile + first blob fetch outside the scenario clock
+        for _ in range(scn.rounds.warmup):
+            before = exp.rounds.n_rounds
+            await self._fire_round()
+            await self._wait(
+                lambda: exp.rounds.n_rounds > before
+                or not exp.rounds.in_progress,
+                timeout_s=max(60.0, 2 * scn.manager.round_timeout),
+            )
+            self.metrics.inc("scenario_warmup_rounds")
+        # whatever landed in rounds.jsonl so far is warm-up; the SLO
+        # evaluator excludes these names (compile time is a harness
+        # property, not a serving-path one)
+        self.warmup_round_names = [
+            r.get("round") for r in read_rounds_jsonl(self.rounds_path)[0]
+        ]
+
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        wall0 = time.time()
+        last_tick = t0
+        next_round_at = t0 + 0.01
+        rounds_fired = 0
+        cur_phase = -1
+        total_s = scn.total_s
+        while True:
+            now = loop.time()
+            elapsed = now - t0
+            if elapsed >= total_s:
+                break
+            dt = now - last_tick
+            last_tick = now
+            pidx, phase, t_in = scn.phase_at(elapsed)
+            if pidx != cur_phase:
+                cur_phase = pidx
+                self._enter_phase(pidx, phase, minj, elapsed)
+                self.phase_log[-1]["wall_ts"] = round(time.time(), 6)
+            self._apply_availability(phase.availability.level_at(t_in))
+            await self._apply_churn(phase, dt)
+            if now >= next_round_at and (
+                scn.rounds.max_rounds is None
+                or rounds_fired < scn.rounds.max_rounds
+            ):
+                rounds_fired += 1
+                next_round_at += scn.rounds.interval_s
+                self._round_tasks.append(
+                    asyncio.ensure_future(self._fire_round())
+                )
+            await asyncio.sleep(self.tick_s)
+
+        # drain: everyone back online, no new rounds; let the last round
+        # finish (the round_timeout watchdog force-finishes stragglers)
+        self._apply_availability(1.0)
+        for inj, rule in self._phase_rules:
+            inj.remove(rule)
+        self._phase_rules.clear()
+        if self._round_tasks:
+            await asyncio.wait(self._round_tasks, timeout=60.0)
+        grace = (scn.rounds.drain_grace_s
+                 if scn.rounds.drain_grace_s is not None
+                 else scn.manager.round_timeout + 5.0)
+        settled = await self._wait(
+            lambda: not exp.rounds.in_progress, timeout_s=grace
+        )
+        if not settled:
+            self.metrics.inc("scenario_rounds_forced_end")
+            exp.end_round()
+
+        # artifacts ---------------------------------------------------
+        async with self._session.get(
+            f"http://127.0.0.1:{self._mport}/{scn.name}/metrics"
+        ) as resp:
+            manager_metrics = await resp.json()
+        loadgen_metrics = self.metrics.snapshot()
+        worker_metrics = self.fleet_metrics.snapshot()
+        records, n_torn = read_rounds_jsonl(self.rounds_path)
+        summary = {
+            "scenario": scn.name,
+            "total_s": total_s,
+            "wall_started": round(wall0, 6),
+            "rounds_fired": rounds_fired,
+            "warmup_round_names": self.warmup_round_names,
+            "phases": self.phase_log,
+            "torn_lines": n_torn,
+            "rounds": self._annotate_rounds(records, wall0),
+            "counters": loadgen_metrics["counters"],
+        }
+        self._write_json("manager_metrics.json", manager_metrics)
+        self._write_json("worker_metrics.json", worker_metrics)
+        self._write_json("loadgen_metrics.json", loadgen_metrics)
+        self._write_json("scenario_summary.json", summary)
+        return summary
+
+    def _annotate_rounds(self, records: List[dict], wall0: float) -> List[dict]:
+        """Per-round digest with the phase each round *started* in
+        (records carry finish-time ``wall_ts`` and ``duration_s``)."""
+        out = []
+        warmup = set(self.warmup_round_names)
+        for r in records:
+            started = float(r.get("wall_ts") or 0.0) - float(
+                r.get("duration_s") or 0.0
+            )
+            entry = {
+                "round": r.get("round"),
+                "outcome": r.get("outcome"),
+                "participants": r.get("participants"),
+                "reporters": r.get("reporters"),
+                "stragglers": len(r.get("stragglers") or ()),
+                "duration_s": r.get("duration_s"),
+                "warmup": r.get("round") in warmup,
+            }
+            if not entry["warmup"]:
+                t = started - wall0
+                entry["scenario_t"] = round(t, 3)
+                entry["phase"] = self.scenario.phase_at(max(0.0, t))[1].name
+            out.append(entry)
+        return out
+
+    def _write_json(self, name: str, obj: dict) -> None:
+        with open(os.path.join(self.artifacts_dir, name), "w",
+                  encoding="utf-8") as fh:
+            json.dump(obj, fh, indent=2, default=repr)
+            fh.write("\n")
+
+    async def _teardown(self) -> None:
+        for task in self._round_tasks:
+            if not task.done():
+                task.cancel()
+                with contextlib.suppress(Exception, asyncio.CancelledError):
+                    await task
+        if self._session is not None:
+            await self._session.close()
+        # workers first (their cleanup pings nothing), manager last
+        for runner in reversed(self._runners):
+            with contextlib.suppress(Exception):
+                await runner.cleanup()
+        for slot in self._slots:
+            await self._reap(slot)
+
+
+async def run_scenario(scenario: Scenario, artifacts_dir: str,
+                       tick_s: float = 0.1) -> dict:
+    return await ScenarioRunner(scenario, artifacts_dir, tick_s=tick_s).run()

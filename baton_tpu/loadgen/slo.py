@@ -1,0 +1,332 @@
+"""SLO evaluator + regression gate over a scenario run's telemetry.
+
+Inputs are exactly what the manager already records — the per-round SLO
+records in ``rounds.jsonl`` (tolerantly read: a torn final line from a
+crash is counted and reported, never raised) and the
+``Experiment.metrics_snapshot()`` dict that also backs ``GET /metrics``
+— plus the loadgen driver's own counters. From those it derives one
+flat ``{metric_name: float}`` namespace:
+
+``rounds.*``
+    Derived from ``rounds.jsonl``: ``total`` / ``completed`` /
+    ``aborted`` / ``completion_rate``, exact quantiles
+    ``duration_p50|p95|p99`` + ``duration_mean|max`` over *completed*
+    rounds, ``participants_mean`` / ``reporters_mean`` /
+    ``straggler_rate``, and per-round byte means
+    ``bytes_uploaded_mean`` / ``bytes_broadcast_mean``.
+``counter:<name>`` / ``gauge:<name>``
+    Straight from the manager snapshot.
+``timer:<name>:<stat>``
+    Histogram timer stats, ``<stat>`` in ``count`` / ``mean`` / ``p50``
+    / ``p95`` / ``p99`` / ``max`` (e.g. ``timer:round_s:p95``).
+``fleet:counter:<name>`` / ``fleet:gauge:<name>`` / ``fleet:timer:…``
+    The worker fleet's shared registry (the engine points every
+    simulated worker at one Metrics instance), e.g.
+    ``fleet:timer:heartbeat_s:p95``.
+``loadgen:<name>``
+    The scenario driver's own counters/gauges (423 refusals, churn
+    events, forced round ends).
+
+A *counter* address that the run never touched resolves to 0 — a
+counter is born at its first ``inc``, so absence IS zero
+(``counter:…``, ``fleet:counter:…``, and the ``loadgen:…`` namespace).
+Every other address — timers, gauges, derived ``rounds.*`` — stays
+missing when unproduced, and missing is a failure: "we stopped
+measuring it" is precisely the regression class that hid the BENCH_r04
+``fused_rounds_per_sec`` drop.
+
+Two gates run over that namespace, both recorded in ``slo_report.json``:
+
+1. **Assertions** from the scenario's ``slo.assertions`` block —
+   ``{"metric", "op", "value"}``; an unresolvable metric is a *failure*
+   (status ``missing``), per the absence rule above.
+2. **Baseline deltas** vs a committed ``benchmarks/scenarios/baselines/
+   *.json`` file: each entry pins ``value``, a ``direction``
+   (``higher_is_better`` / ``lower_is_better``) and a relative
+   ``tolerance`` (plus optional absolute ``tolerance_abs``); an
+   observation worse than ``value ± tolerance`` — or missing from the
+   run (counter addresses excepted, see above) — is a regression.
+
+``evaluate_slo`` returns the full report; ``report["pass"]`` is the CI
+verdict (any failed/missing assertion or any baseline regression ⇒
+``False``, and the CLI exits nonzero).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from baton_tpu.loadgen.scenario import (
+    SLO_OPS,
+    ScenarioError,
+    SLOAssertion,
+    SLOSpec,
+)
+
+_TIMER_STATS = {
+    "count": "count",
+    "mean": "mean_s",
+    "p50": "p50_s",
+    "p95": "p95_s",
+    "p99": "p99_s",
+    "max": "max_s",
+}
+
+_DIRECTIONS = ("higher_is_better", "lower_is_better")
+
+
+def _count(v: Any) -> int:
+    """Record fields that enumerate clients (``stragglers``) hold id
+    lists; count-valued fields hold numbers. Normalize either to an
+    int."""
+    if isinstance(v, (list, tuple)):
+        return len(v)
+    if isinstance(v, (int, float)):
+        return int(v)
+    return 0
+
+
+def _quantile(sorted_vals: Sequence[float], q: float) -> float:
+    """Exact linear-interpolation quantile over a sorted sample (the
+    rounds sample is small, unlike the manager's O(1) histograms)."""
+    n = len(sorted_vals)
+    if n == 1:
+        return sorted_vals[0]
+    rank = q * (n - 1)
+    lo = int(rank)
+    hi = min(lo + 1, n - 1)
+    frac = rank - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+def resolve_metric(metrics: Dict[str, float], name: str) -> Optional[float]:
+    """Metric lookup with the counter absence-is-zero rule (module
+    docstring): an untouched counter address resolves to 0.0, anything
+    else absent resolves to None (→ missing/regression)."""
+    val = metrics.get(name)
+    if val is not None:
+        return val
+    if name.startswith(("counter:", "fleet:counter:", "loadgen:")):
+        return 0.0
+    return None
+
+
+def derive_metrics(
+    records: List[dict],
+    snapshot: Optional[dict] = None,
+    loadgen_snapshot: Optional[dict] = None,
+    fleet_snapshot: Optional[dict] = None,
+) -> Dict[str, float]:
+    """Flatten rounds.jsonl + the metrics snapshots into one
+    ``{metric: float}`` namespace (see module docstring). Metrics whose
+    inputs are absent (no completed rounds → no duration quantiles) are
+    simply not present — the assertion layer turns absence into
+    failure."""
+    m: Dict[str, float] = {}
+    total = len(records)
+    completed = [r for r in records if r.get("outcome") == "completed"]
+    m["rounds.total"] = float(total)
+    m["rounds.completed"] = float(len(completed))
+    m["rounds.aborted"] = float(total - len(completed))
+    if total:
+        m["rounds.completion_rate"] = len(completed) / total
+
+    durs = sorted(
+        float(r["duration_s"]) for r in completed
+        if isinstance(r.get("duration_s"), (int, float))
+    )
+    if durs:
+        m["rounds.duration_p50"] = _quantile(durs, 0.50)
+        m["rounds.duration_p95"] = _quantile(durs, 0.95)
+        m["rounds.duration_p99"] = _quantile(durs, 0.99)
+        m["rounds.duration_mean"] = sum(durs) / len(durs)
+        m["rounds.duration_max"] = durs[-1]
+
+    def _mean(field: str, over: List[dict]) -> Optional[float]:
+        vals = [
+            float(r[field]) for r in over
+            if isinstance(r.get(field), (int, float))
+        ]
+        return sum(vals) / len(vals) if vals else None
+
+    for field, out in (
+        ("participants", "rounds.participants_mean"),
+        ("reporters", "rounds.reporters_mean"),
+        ("bytes_uploaded", "rounds.bytes_uploaded_mean"),
+        ("bytes_broadcast", "rounds.bytes_broadcast_mean"),
+    ):
+        val = _mean(field, completed)
+        if val is not None:
+            m[out] = val
+
+    n_participants = sum(
+        _count(r.get("participants")) for r in completed
+    )
+    if n_participants:
+        m["rounds.straggler_rate"] = sum(
+            _count(r.get("stragglers")) for r in completed
+        ) / n_participants
+
+    for prefix, snap in (("", snapshot), ("fleet:", fleet_snapshot)):
+        if not snap:
+            continue
+        for k, v in (snap.get("counters") or {}).items():
+            m[f"{prefix}counter:{k}"] = float(v)
+        for k, v in (snap.get("gauges") or {}).items():
+            m[f"{prefix}gauge:{k}"] = float(v)
+        for name, st in (snap.get("timers") or {}).items():
+            for stat, key in _TIMER_STATS.items():
+                if key in st:
+                    m[f"{prefix}timer:{name}:{stat}"] = float(st[key])
+    if loadgen_snapshot:
+        for k, v in (loadgen_snapshot.get("counters") or {}).items():
+            m[f"loadgen:{k}"] = float(v)
+        for k, v in (loadgen_snapshot.get("gauges") or {}).items():
+            m[f"loadgen:{k}"] = float(v)
+    return m
+
+
+def _compare(observed: float, op: str, value: float) -> bool:
+    if op == "<=":
+        return observed <= value
+    if op == ">=":
+        return observed >= value
+    if op == "<":
+        return observed < value
+    if op == ">":
+        return observed > value
+    if op == "==":
+        return observed == value
+    raise ScenarioError(f"unknown SLO op {op!r} (known: {SLO_OPS})")
+
+
+def check_assertions(
+    assertions: Iterable[SLOAssertion], metrics: Dict[str, float]
+) -> List[dict]:
+    out = []
+    for a in assertions:
+        observed = resolve_metric(metrics, a.metric)
+        if observed is None:
+            status = "missing"
+        else:
+            status = "pass" if _compare(observed, a.op, a.value) else "fail"
+        out.append({
+            "metric": a.metric, "op": a.op, "value": a.value,
+            "observed": observed, "status": status,
+        })
+    return out
+
+
+def load_baseline(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        try:
+            data = json.load(fh)
+        except ValueError as exc:
+            raise ScenarioError(f"{path}: not valid JSON: {exc}") from exc
+    metrics = data.get("metrics")
+    if not isinstance(metrics, dict) or not metrics:
+        raise ScenarioError(f"{path}: baseline needs a non-empty `metrics` map")
+    for name, spec in metrics.items():
+        if not isinstance(spec, dict) or "value" not in spec:
+            raise ScenarioError(f"{path}: baseline metric {name!r} needs `value`")
+        if spec.get("direction", "higher_is_better") not in _DIRECTIONS:
+            raise ScenarioError(
+                f"{path}: baseline metric {name!r} direction must be one of "
+                f"{_DIRECTIONS}"
+            )
+    return data
+
+
+def check_baseline(
+    baseline: dict, metrics: Dict[str, float]
+) -> List[dict]:
+    """Per-baseline-metric delta report. An entry regresses when the
+    observation is worse than ``value`` by more than the tolerance in
+    the bad direction — or when the run stopped producing the metric at
+    all (the silent-drop failure mode)."""
+    results = []
+    for name, spec in baseline.get("metrics", {}).items():
+        value = float(spec["value"])
+        direction = spec.get("direction", "higher_is_better")
+        tol = float(spec.get("tolerance", 0.0))
+        tol_abs = float(spec.get("tolerance_abs", 0.0))
+        observed = resolve_metric(metrics, name)
+        entry: Dict[str, Any] = {
+            "metric": name, "baseline": value, "direction": direction,
+            "observed": observed, "delta": None, "delta_rel": None,
+        }
+        if observed is None:
+            entry["regression"] = True
+            entry["note"] = "metric missing from this run"
+            results.append(entry)
+            continue
+        delta = observed - value
+        entry["delta"] = delta
+        if value:
+            entry["delta_rel"] = delta / abs(value)
+        slack = abs(value) * tol + tol_abs
+        if direction == "higher_is_better":
+            entry["regression"] = observed < value - slack
+        else:
+            entry["regression"] = observed > value + slack
+        results.append(entry)
+    return results
+
+
+def evaluate_slo(
+    slo: SLOSpec,
+    records: List[dict],
+    snapshot: Optional[dict] = None,
+    *,
+    loadgen_snapshot: Optional[dict] = None,
+    fleet_snapshot: Optional[dict] = None,
+    baseline: Optional[dict] = None,
+    n_torn: int = 0,
+    exclude_rounds: Iterable[str] = (),
+    scenario_name: Optional[str] = None,
+) -> dict:
+    """The full SLO verdict for one run.
+
+    ``exclude_rounds`` filters warm-up rounds out of the derived
+    ``rounds.*`` metrics by round name (XLA compile time is a property
+    of the harness, not the serving path). ``baseline`` overrides the
+    on-disk file; otherwise ``slo.baseline`` is loaded when set.
+    """
+    excluded = set(exclude_rounds)
+    kept = [r for r in records if r.get("round") not in excluded]
+    metrics = derive_metrics(kept, snapshot, loadgen_snapshot, fleet_snapshot)
+    assertions = check_assertions(slo.assertions, metrics)
+
+    baseline_block = None
+    if baseline is None and slo.baseline is not None:
+        baseline = load_baseline(slo.baseline)
+    if baseline is not None:
+        results = check_baseline(baseline, metrics)
+        baseline_block = {
+            "path": slo.baseline,
+            "results": results,
+            "regressions": sum(1 for r in results if r["regression"]),
+        }
+
+    ok = all(a["status"] == "pass" for a in assertions) and (
+        baseline_block is None or baseline_block["regressions"] == 0
+    )
+    return {
+        "scenario": scenario_name,
+        "pass": ok,
+        "rounds_evaluated": len(kept),
+        "rounds_excluded_warmup": len(records) - len(kept),
+        "torn_lines": n_torn,
+        "assertions": assertions,
+        "baseline": baseline_block,
+        "metrics": metrics,
+    }
+
+
+def write_report(report: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=2, sort_keys=False)
+        fh.write("\n")

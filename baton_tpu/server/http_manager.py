@@ -824,7 +824,12 @@ class Experiment:
     async def handle_loss_history(self, request: web.Request) -> web.Response:
         return web.json_response([float(x) for x in self.rounds.loss_history])
 
-    async def handle_metrics(self, request: web.Request) -> web.Response:
+    def metrics_snapshot(self) -> dict:
+        """The full metrics snapshot — counters, gauges, histogram
+        timers (p50/p95/p99), plus the derived registry/round gauges.
+        This is the ONE producer behind both ``GET /{name}/metrics`` and
+        the loadgen SLO evaluator (:mod:`baton_tpu.loadgen.slo`), so the
+        scraped view and the gated view cannot drift."""
         from baton_tpu.server import secure
 
         snap = self.metrics.snapshot()
@@ -835,7 +840,10 @@ class Experiment:
         snap["gauges"]["dh_cache_size"] = float(dh["size"])
         snap["gauges"]["dh_cache_hits"] = float(dh["hits"])
         snap["gauges"]["dh_cache_misses"] = float(dh["misses"])
-        return web.json_response(snap)
+        return snap
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(self.metrics_snapshot())
 
     # -- distributed tracing -------------------------------------------
     def _round_trace_id(self, rid: str) -> str:

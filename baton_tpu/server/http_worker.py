@@ -141,6 +141,7 @@ class ExperimentWorker:
         outbox_dir: Optional[str] = None,
         upload_chunk_bytes: Optional[int] = None,
         max_broadcast_bytes: Optional[int] = 1 << 30,
+        train_time_scale: float = 1.0,
     ):
         """``compress`` turns on sparse round-delta uploads
         (ops/compression.py): ``"topk:0.05"`` keeps the top 5% of delta
@@ -170,7 +171,14 @@ class ExperimentWorker:
         (the v1 push path; v2 pull rounds carry only a small envelope).
         Oversized broadcasts get a 413 instead of an unbounded buffer.
         ``None`` disables the cap. Default 1 GiB — far above any real
-        model push, low enough to bound a misbehaving peer."""
+        model push, low enough to bound a misbehaving peer.
+
+        ``train_time_scale``: simulated device-speed multiplier, >= 1.0.
+        After real training finishes, the worker idles inside the
+        ``local_train`` span until the round's compute has taken
+        ``scale ×`` its measured wall time — a 3.0 worker behaves like
+        hardware 3× slower without burning 3× the CPU. Load-generation
+        knob (stragglers, heterogeneous fleets); 1.0 = off."""
         self.name = name or getattr(model, "name", "fedmodel")
         self.model = model
         self.metrics = Metrics()
@@ -227,6 +235,12 @@ class ExperimentWorker:
                 f"got {max_broadcast_bytes}"
             )
         self.max_broadcast_bytes = max_broadcast_bytes
+        if not train_time_scale >= 1.0:
+            raise ValueError(
+                f"train_time_scale must be >= 1.0 (a simulated device "
+                f"cannot outrun the real one), got {train_time_scale}"
+            )
+        self.train_time_scale = float(train_time_scale)
         self._pending: Optional[_PendingUpdate] = self._load_persisted()
         if self._pending is not None:
             self.metrics.set_gauge("outbox_pending", 1)
@@ -1057,7 +1071,17 @@ class ExperimentWorker:
                 "local_train", trace_id=trace_id, round=round_name,
                 n_epoch=n_epoch, n_samples=n_samples,
             ) as train_sp:
+                loop = asyncio.get_running_loop()
+                t_train0 = loop.time()
                 params, loss_history = await asyncio.to_thread(train)
+                if self.train_time_scale > 1.0:
+                    # pad to scale× the measured compute time: simulated
+                    # slow hardware, same numerics (see __init__ doc)
+                    extra = (self.train_time_scale - 1.0) * (
+                        loop.time() - t_train0
+                    )
+                    train_sp.set(time_scale=self.train_time_scale)
+                    await asyncio.sleep(extra)
                 if len(loss_history):
                     train_sp.set(final_loss=float(loss_history[-1]))
             self.params = params

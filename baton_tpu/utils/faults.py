@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from aiohttp import web
 
@@ -34,9 +34,18 @@ class Rule:
     delay_s: float = 0.0            # for "delay"
     times: Optional[int] = None     # None = unlimited
     hits: int = 0
+    # Optional dynamic guard: the rule only fires while gate() is truthy.
+    # Lets a harness flip a standing rule on/off (e.g. an availability
+    # curve toggling a worker's 503 refusal) without mutating the rule
+    # list from another task mid-iteration.
+    gate: Optional[Callable[[], bool]] = None
 
     def applies(self, path: str) -> bool:
-        return self.match in path and (self.times is None or self.hits < self.times)
+        if self.match not in path:
+            return False
+        if self.times is not None and self.hits >= self.times:
+            return False
+        return self.gate is None or bool(self.gate())
 
 
 def _match_target(request: web.Request) -> str:
@@ -78,20 +87,32 @@ class FaultInjector:
         self.middleware = middleware
 
     # ------------------------------------------------------------------
-    def error(self, match: str, status: int = 503, times: Optional[int] = None) -> Rule:
-        rule = Rule(match=match, action="error", status=status, times=times)
+    def error(self, match: str, status: int = 503, times: Optional[int] = None,
+              gate: Optional[Callable[[], bool]] = None) -> Rule:
+        rule = Rule(match=match, action="error", status=status, times=times,
+                    gate=gate)
         self.rules.append(rule)
         return rule
 
-    def delay(self, match: str, seconds: float, times: Optional[int] = None) -> Rule:
-        rule = Rule(match=match, action="delay", delay_s=seconds, times=times)
+    def delay(self, match: str, seconds: float, times: Optional[int] = None,
+              gate: Optional[Callable[[], bool]] = None) -> Rule:
+        rule = Rule(match=match, action="delay", delay_s=seconds, times=times,
+                    gate=gate)
         self.rules.append(rule)
         return rule
 
-    def drop(self, match: str, times: Optional[int] = None) -> Rule:
-        rule = Rule(match=match, action="drop", times=times)
+    def drop(self, match: str, times: Optional[int] = None,
+             gate: Optional[Callable[[], bool]] = None) -> Rule:
+        rule = Rule(match=match, action="drop", times=times, gate=gate)
         self.rules.append(rule)
         return rule
 
     def clear(self) -> None:
         self.rules.clear()
+
+    def remove(self, rule: Rule) -> None:
+        """Detach one rule (phase-scoped faults end with their phase)."""
+        try:
+            self.rules.remove(rule)
+        except ValueError:
+            pass

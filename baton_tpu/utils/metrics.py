@@ -26,7 +26,8 @@ from typing import Dict, List, Optional
 # Declared metric registries.
 #
 # Dashboards and alert rules key on exact metric names, so every
-# counter/timer/gauge touched under baton_tpu/server/ must be declared
+# counter/timer/gauge touched under baton_tpu/server/ or
+# baton_tpu/loadgen/ must be declared
 # here — batonlint rule BTL030 enforces it (the linter parses these
 # literals with ast.literal_eval; keep them plain literals, no computed
 # values). Counter FAMILIES whose suffix is built at runtime (f-strings
@@ -88,6 +89,14 @@ DECLARED_COUNTERS = frozenset({
     # worker: trace shipping
     "trace_spans_shipped",
     "trace_ship_failed",
+    # loadgen: open-loop scenario driver (baton_tpu/loadgen/engine.py)
+    "scenario_rounds_started",
+    "scenario_rounds_refused_423",
+    "scenario_start_round_errors",
+    "scenario_rounds_forced_end",
+    "scenario_workers_joined",
+    "scenario_workers_left",
+    "scenario_warmup_rounds",
 })
 
 DECLARED_COUNTER_PREFIXES = (
@@ -126,6 +135,11 @@ DECLARED_GAUGES = frozenset({
     "train_epoch_loss",
     # both: LoopLagProbe scheduling-delay gauge
     "loop_lag_s",
+    # loadgen: scenario driver state
+    "scenario_workers_available",
+    "scenario_workers_alive",
+    "scenario_phase_index",
+    "scenario_availability",
 })
 
 

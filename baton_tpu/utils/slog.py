@@ -89,22 +89,43 @@ class RoundsLog:
         os.makedirs(parent, exist_ok=True)
 
     def append(self, record: Dict[str, Any]) -> None:
-        line = json.dumps(
+        # Crash-safety: the full line (terminator included) goes down in
+        # ONE write() followed by a flush, so a reader racing the writer
+        # — or a crash mid-record — can tear at most the final line,
+        # never interleave two records.
+        data = json.dumps(
             dict(record, wall_ts=round(time.time(), 6)), default=repr
-        )
+        ) + "\n"
         with self._lock:
             with open(self.path, "a", encoding="utf-8") as fh:
-                fh.write(line + "\n")
+                fh.write(data)
+                fh.flush()
 
     def read_all(self) -> list:
-        """Parse every record back (test/harness convenience)."""
-        out = []
-        try:
-            with open(self.path, "r", encoding="utf-8") as fh:
-                for line in fh:
-                    line = line.strip()
-                    if line:
-                        out.append(json.loads(line))
-        except OSError:
-            pass
-        return out
+        """Parse every record back (test/harness convenience). Torn or
+        malformed lines are skipped, not raised; use
+        :func:`read_rounds_jsonl` when the torn-line count matters."""
+        return read_rounds_jsonl(self.path)[0]
+
+
+def read_rounds_jsonl(path: str) -> tuple:
+    """Tolerant ``rounds.jsonl`` reader: returns ``(records, n_torn)``.
+
+    A crash mid-append (or a reader racing the writer's final line) can
+    leave a torn trailing line; report it rather than raising so an SLO
+    evaluation over a crashed run still sees every complete record.
+    """
+    records, n_torn = [], 0
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    n_torn += 1
+    except OSError:
+        pass
+    return records, n_torn

@@ -509,8 +509,17 @@ def main() -> None:
                         wave_size=wave_size, collect_client_losses=False)
     float(res.loss_history[-1])
     est = time.perf_counter() - t_e
-    timed_rounds = int(max(3, min(50, (remaining() - 30.0) / max(est, 1e-3))))
-    log(f"steady-state estimate {est:.3f}s/round -> timing {timed_rounds} rounds")
+    # Reserve budget for the fused stage BEFORE sizing the dispatch
+    # loop: in BENCH_r04/r05 the dispatch loop ate the whole window and
+    # the fused measurement silently went null. The reserve covers the
+    # fused compile (scan shell over the cached wave kernel) plus two
+    # k_f-round executions.
+    fused_reserve = min(90.0, 1.5 * compile_s + 25.0 * est + 15.0)
+    timed_rounds = int(max(
+        3, min(50, (remaining() - 20.0 - fused_reserve) / max(est, 1e-3))
+    ))
+    log(f"steady-state estimate {est:.3f}s/round -> timing {timed_rounds} "
+        f"rounds (fused reserve {fused_reserve:.0f}s)")
 
     p = res.params
     t0 = time.perf_counter()
@@ -529,9 +538,14 @@ def main() -> None:
     # Only attempted when budget remains; it shares the compiled wave kernel
     # cache with run_round so the extra compile is the scan shell only.
     fused_rps = None
-    if remaining() > max(60.0, 3 * compile_s * 0.5):
+    fused_skip_reason = None
+    k_f = min(timed_rounds, 10)
+    # need ≈ one scan-shell compile + 2 × k_f rounds + margin. No flat
+    # 60 s floor: that floor is what skipped the measurement entirely on
+    # short/degraded budgets (fused_rounds_per_sec null in BENCH_r04/r05).
+    fused_need = 1.2 * compile_s + 2.0 * k_f * est + 10.0
+    if remaining() > fused_need:
         try:
-            k_f = min(timed_rounds, 10)
             t_fc = time.perf_counter()
             p2, hist = sim.run_rounds_fused(
                 p, data, n_samples, jax.random.fold_in(key, 999),
@@ -549,9 +563,19 @@ def main() -> None:
                 fused_rps = k_f / fused_dt
                 log(f"fused steady state: {k_f} rounds in {fused_dt:.2f}s "
                     f"-> {fused_rps:.3f} rounds/s")
+            else:
+                fused_skip_reason = (
+                    f"budget after fused compile: {remaining():.0f}s left"
+                )
         except Exception as e:  # fused path is an optimization, not the gate
+            fused_skip_reason = f"failed: {type(e).__name__}: {e}"
             log(f"fused path failed ({type(e).__name__}: {e}); "
                 "keeping per-round number")
+    else:
+        fused_skip_reason = (
+            f"budget: {remaining():.0f}s left < {fused_need:.0f}s needed"
+        )
+        log(f"fused path skipped ({fused_skip_reason})")
 
     # --- flash-attention micro-bench: Pallas kernel vs dense einsum ---
     # The model zoo defaults to the flash kernel on TPU
@@ -669,6 +693,7 @@ def main() -> None:
         "peak_hbm_source": peak_hbm_source,
         "dispatch_rounds_per_sec": round(rounds_per_sec, 3),
         "fused_rounds_per_sec": round(fused_rps, 3) if fused_rps else None,
+        "fused_skip_reason": fused_skip_reason,
         "attention_bench": attn_bench,
         "wave_sweep_recorded": _recorded_wave_sweep(),
         "wave1024_recorded": _recorded_wave1024(),

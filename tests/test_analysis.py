@@ -651,6 +651,23 @@ def test_btl030_conditional_branch_typo_is_flagged():
     assert len(findings) == 1
 
 
+def test_btl030_audits_loadgen_like_server():
+    # the scenario driver's counters feed the SLO gate, so a typo'd
+    # name there silently zeroes a gated metric — same stakes as server/
+    src = """
+    def f(m):
+        m.inc("updates_recieved")
+    """
+    assert rules_of(lint(
+        src, path="baton_tpu/loadgen/fixture.py",
+        rules=["BTL030"], registry=REGISTRY,
+    )) == ["BTL030"]
+    assert lint(
+        src, path="baton_tpu/core/fixture.py",
+        rules=["BTL030"], registry=REGISTRY,
+    ) == []
+
+
 def test_btl030_disabled_without_registry():
     findings = lint(
         """
