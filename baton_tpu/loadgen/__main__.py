@@ -69,11 +69,17 @@ def main(argv=None) -> int:
     with open(os.path.join(artifacts, "worker_metrics.json"),
               encoding="utf-8") as fh:
         fleet_snapshot = json.load(fh)
+    edge_snapshot = None
+    edge_metrics_path = os.path.join(artifacts, "edge_metrics.json")
+    if os.path.exists(edge_metrics_path):
+        with open(edge_metrics_path, encoding="utf-8") as fh:
+            edge_snapshot = json.load(fh)
     try:
         report = evaluate_slo(
             scenario.slo, records, snapshot,
             loadgen_snapshot=loadgen_snapshot,
             fleet_snapshot=fleet_snapshot,
+            edge_snapshot=edge_snapshot,
             n_torn=n_torn,
             exclude_rounds=summary["warmup_round_names"],
             scenario_name=scenario.name,

@@ -60,8 +60,10 @@ from baton_tpu.core.training import make_local_trainer
 from baton_tpu.data.synthetic import linear_client_data
 from baton_tpu.loadgen.scenario import PhaseSpec, Scenario
 from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.server.edge import EdgeAggregator
 from baton_tpu.server.http_manager import Manager
 from baton_tpu.server.http_worker import ExperimentWorker
+from baton_tpu.server.topology import EdgeTopology
 from baton_tpu.utils.faults import FaultInjector, Rule
 from baton_tpu.utils.metrics import Metrics
 from baton_tpu.utils.slog import read_rounds_jsonl
@@ -91,6 +93,22 @@ class _WorkerSlot:
         self.alive = True
 
 
+class _EdgeSlot:
+    """One edge aggregator: its server runner, loopback port, and
+    liveness (a killed edge's runner is torn down cold — no drain, no
+    goodbye, exactly like a zone loss)."""
+
+    __slots__ = ("name", "edge", "runner", "port", "alive")
+
+    def __init__(self, name: str, edge: EdgeAggregator,
+                 runner: web.AppRunner, port: int) -> None:
+        self.name = name
+        self.edge = edge
+        self.runner = runner
+        self.port = port
+        self.alive = True
+
+
 class ScenarioRunner:
     """Drives one scenario end to end; :meth:`run` returns the summary
     dict (also written to ``scenario_summary.json``)."""
@@ -105,6 +123,11 @@ class ScenarioRunner:
         # heartbeat/upload histograms instead of per-process islands
         # (exported as worker_metrics.json, addressed as ``fleet:*``)
         self.fleet_metrics = Metrics()
+        # likewise one shared registry across the edge tier (exported
+        # as edge_metrics.json, addressed as ``edge:*``)
+        self.edge_metrics = Metrics()
+        self._edge_slots: List[_EdgeSlot] = []
+        self._topology: Optional[EdgeTopology] = None
         self.rounds_path = os.path.join(artifacts_dir, "rounds.jsonl")
         self._rng = random.Random(scenario.seed)
         self._nprng = np.random.default_rng(scenario.seed)
@@ -124,6 +147,49 @@ class ScenarioRunner:
         self._coef = None
         self.warmup_round_names: List[str] = []
         self.phase_log: List[dict] = []
+
+    # -- edge tier -----------------------------------------------------
+    async def _spawn_edge(self, i: int) -> _EdgeSlot:
+        scn = self.scenario
+        port = _free_port()
+        eapp = web.Application()
+        edge = EdgeAggregator(
+            eapp, f"127.0.0.1:{self._mport}", name=scn.name, port=port,
+            edge_name=f"e{i}",
+            heartbeat_time=scn.edges.heartbeat_time,
+            flush_after_s=scn.edges.flush_after_s,
+            metrics=self.edge_metrics,
+        )
+        runner = web.AppRunner(eapp)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        slot = _EdgeSlot(f"e{i}", edge, runner, port)
+        self._edge_slots.append(slot)
+        self._runners.append(runner)
+        self.metrics.inc("scenario_edges_started")
+        return slot
+
+    async def _kill_edge(self, slot: _EdgeSlot) -> None:
+        """Cold teardown: the cohort's workers discover the loss via
+        transport errors and fall back direct to the root."""
+        slot.alive = False
+        if self._topology is not None:
+            self._topology.mark_dead(slot.name)
+        with contextlib.suppress(Exception):
+            await slot.runner.cleanup()
+        self.metrics.inc("scenario_edges_killed")
+        log.info("loadgen: killed edge %s (port %d)", slot.name, slot.port)
+
+    def _edge_for(self, idx: int) -> Optional[str]:
+        """``host:port`` of the live edge a worker routes through, via
+        the consistent-hash ring — None in the flat topology."""
+        if self._topology is None:
+            return None
+        name = self._topology.assign(f"w{idx}")
+        for slot in self._edge_slots:
+            if slot.name == name:
+                return f"127.0.0.1:{slot.port}"
+        return None
 
     # -- fleet ---------------------------------------------------------
     async def _spawn_worker(self) -> _WorkerSlot:
@@ -149,6 +215,8 @@ class ScenarioRunner:
             outbox_backoff=(0.05, 0.5),
             upload_chunk_bytes=scn.workers.upload_chunk_bytes,
             train_time_scale=scn.workers.speed_for(idx),
+            edge=self._edge_for(idx),
+            edge_retry_s=scn.edges.retry_s,
         )
         worker.metrics = self.fleet_metrics
         runner = web.AppRunner(wapp)
@@ -198,11 +266,14 @@ class ScenarioRunner:
             self._phase_rules.append((inj, rule))
         return rule
 
-    def _enter_phase(self, idx: int, phase: PhaseSpec, minj: FaultInjector,
-                     elapsed: float) -> None:
+    async def _enter_phase(self, idx: int, phase: PhaseSpec,
+                           minj: FaultInjector, elapsed: float) -> None:
         for inj, rule in self._phase_rules:
             inj.remove(rule)
         self._phase_rules.clear()
+        for k in phase.kill_edges:
+            if k < len(self._edge_slots) and self._edge_slots[k].alive:
+                await self._kill_edge(self._edge_slots[k])
         self._active_worker_faults = []
         for fs in phase.faults:
             if fs.target == "manager":
@@ -317,15 +388,23 @@ class ScenarioRunner:
         scn = self.scenario
         exp = self._exp
 
+        if scn.edges.count > 0:
+            self._topology = EdgeTopology(
+                [f"e{i}" for i in range(scn.edges.count)]
+            )
+            for i in range(scn.edges.count):
+                await self._spawn_edge(i)
         for _ in range(scn.workers.count):
             await self._spawn_worker()
+        # each edge registers its own root credentials too
+        expected = scn.workers.count + scn.edges.count
         ok = await self._wait(
-            lambda: len(exp.registry) >= scn.workers.count, timeout_s=30.0
+            lambda: len(exp.registry) >= expected, timeout_s=30.0
         )
         if not ok:
             raise RuntimeError(
                 f"fleet failed to register: {len(exp.registry)}"
-                f"/{scn.workers.count} after 30s"
+                f"/{expected} after 30s"
             )
 
         # warm-up: compile + first blob fetch outside the scenario clock
@@ -363,7 +442,7 @@ class ScenarioRunner:
             pidx, phase, t_in = scn.phase_at(elapsed)
             if pidx != cur_phase:
                 cur_phase = pidx
-                self._enter_phase(pidx, phase, minj, elapsed)
+                await self._enter_phase(pidx, phase, minj, elapsed)
                 self.phase_log[-1]["wall_ts"] = round(time.time(), 6)
             self._apply_availability(phase.availability.level_at(t_in))
             await self._apply_churn(phase, dt)
@@ -403,10 +482,15 @@ class ScenarioRunner:
             manager_metrics = await resp.json()
         loadgen_metrics = self.metrics.snapshot()
         worker_metrics = self.fleet_metrics.snapshot()
+        edge_metrics = self.edge_metrics.snapshot()
         records, n_torn = read_rounds_jsonl(self.rounds_path)
         summary = {
             "scenario": scn.name,
             "total_s": total_s,
+            "edges": {
+                "count": scn.edges.count,
+                "alive": sum(1 for s in self._edge_slots if s.alive),
+            },
             "wall_started": round(wall0, 6),
             "rounds_fired": rounds_fired,
             "warmup_round_names": self.warmup_round_names,
@@ -417,6 +501,8 @@ class ScenarioRunner:
         }
         self._write_json("manager_metrics.json", manager_metrics)
         self._write_json("worker_metrics.json", worker_metrics)
+        if scn.edges.count > 0:
+            self._write_json("edge_metrics.json", edge_metrics)
         self._write_json("loadgen_metrics.json", loadgen_metrics)
         self._write_json("scenario_summary.json", summary)
         return summary

@@ -181,12 +181,16 @@ class PhaseSpec:
     availability: AvailabilitySpec
     churn: ChurnSpec
     faults: Tuple[FaultSpec, ...]
+    #: edge indices (into ``edges.count``) torn down cold when this
+    #: phase is entered — the edge-death chaos knob
+    kill_edges: Tuple[int, ...] = ()
 
     @staticmethod
     def parse(d: Dict[str, Any], idx: int) -> "PhaseSpec":
         ctx = f"phases[{idx}]"
         f = _take(d, ctx, name=f"phase{idx}", duration_s=None,
-                  availability=None, churn=None, faults=None)
+                  availability=None, churn=None, faults=None,
+                  kill_edges=None)
         if not isinstance(f["name"], str) or not f["name"]:
             raise ScenarioError(f"{ctx}: `name` must be a non-empty string")
         dur = _num(ctx, "duration_s", f["duration_s"], 1e-3)
@@ -201,7 +205,14 @@ class PhaseSpec:
             FaultSpec.parse(fd, f"{ctx}.faults[{i}]")
             for i, fd in enumerate(raw_faults)
         )
-        return PhaseSpec(f["name"], dur, avail, churn, faults)
+        raw_kills = f["kill_edges"] or []
+        if not isinstance(raw_kills, list):
+            raise ScenarioError(f"{ctx}: `kill_edges` must be a list")
+        kills = tuple(
+            int(_num(f"{ctx}.kill_edges[{i}]", "index", k, 0))
+            for i, k in enumerate(raw_kills)
+        )
+        return PhaseSpec(f["name"], dur, avail, churn, faults, kills)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -271,6 +282,35 @@ class WorkerSpec:
                 return g.scale
             lo += n
         return 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeSpec:
+    """The hierarchical aggregation tier (``server/edge.py``): ``count``
+    edge aggregators between the fleet and the root manager, workers
+    assigned by consistent hash (``server/topology.py``). ``count: 0``
+    (the default) is the flat topology — every worker talks to the root
+    directly. ``retry_s`` is how long a worker sits on the direct
+    fallback route after an edge transport failure before re-trying its
+    edge."""
+
+    count: int = 0
+    flush_after_s: float = 15.0
+    heartbeat_time: float = 1.0
+    retry_s: float = 30.0
+
+    @staticmethod
+    def parse(d: Dict[str, Any]) -> "EdgeSpec":
+        ctx = "edges"
+        f = _take(d, ctx, count=0, flush_after_s=15.0, heartbeat_time=1.0,
+                  retry_s=30.0)
+        return EdgeSpec(
+            count=int(_num(ctx, "count", f["count"], 0)),
+            flush_after_s=_num(ctx, "flush_after_s", f["flush_after_s"], 0.05),
+            heartbeat_time=_num(ctx, "heartbeat_time", f["heartbeat_time"],
+                                0.05),
+            retry_s=_num(ctx, "retry_s", f["retry_s"], 0.0),
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -384,6 +424,7 @@ class Scenario:
     rounds: RoundsSpec
     phases: Tuple[PhaseSpec, ...]
     slo: SLOSpec
+    edges: EdgeSpec = EdgeSpec()
 
     @property
     def total_s(self) -> float:
@@ -407,7 +448,7 @@ class Scenario:
 
 def parse_scenario(d: Dict[str, Any], base_dir: str = ".") -> Scenario:
     f = _take(d, "scenario", name=None, seed=0, model=None, workers=None,
-              manager=None, rounds=None, phases=None, slo=None)
+              manager=None, rounds=None, phases=None, slo=None, edges=None)
     name = f["name"]
     if not isinstance(name, str) or not _NAME_RE.match(name):
         raise ScenarioError(
@@ -418,6 +459,15 @@ def parse_scenario(d: Dict[str, Any], base_dir: str = ".") -> Scenario:
     phases_raw = f["phases"]
     if not isinstance(phases_raw, list) or not phases_raw:
         raise ScenarioError("scenario needs a non-empty `phases` list")
+    edges = EdgeSpec.parse(f["edges"] or {})
+    phases = tuple(PhaseSpec.parse(p, i) for i, p in enumerate(phases_raw))
+    for i, p in enumerate(phases):
+        for k in p.kill_edges:
+            if k >= edges.count:
+                raise ScenarioError(
+                    f"phases[{i}]: kill_edges index {k} out of range "
+                    f"(edges.count = {edges.count})"
+                )
     return Scenario(
         name=name,
         seed=int(_num("scenario", "seed", f["seed"])),
@@ -425,8 +475,9 @@ def parse_scenario(d: Dict[str, Any], base_dir: str = ".") -> Scenario:
         workers=WorkerSpec.parse(f["workers"] or {}),
         manager=ManagerSpec.parse(f["manager"] or {}),
         rounds=RoundsSpec.parse(f["rounds"] or {}),
-        phases=tuple(PhaseSpec.parse(p, i) for i, p in enumerate(phases_raw)),
+        phases=phases,
         slo=SLOSpec.parse(f["slo"] or {}, base_dir),
+        edges=edges,
     )
 
 

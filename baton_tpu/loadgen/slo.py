@@ -108,7 +108,8 @@ def resolve_metric(metrics: Dict[str, float], name: str) -> Optional[float]:
     val = metrics.get(name)
     if val is not None:
         return val
-    if name.startswith(("counter:", "fleet:counter:", "loadgen:")):
+    if name.startswith(("counter:", "fleet:counter:", "edge:counter:",
+                        "loadgen:")):
         return 0.0
     return None
 
@@ -118,6 +119,7 @@ def derive_metrics(
     snapshot: Optional[dict] = None,
     loadgen_snapshot: Optional[dict] = None,
     fleet_snapshot: Optional[dict] = None,
+    edge_snapshot: Optional[dict] = None,
 ) -> Dict[str, float]:
     """Flatten rounds.jsonl + the metrics snapshots into one
     ``{metric: float}`` namespace (see module docstring). Metrics whose
@@ -169,7 +171,8 @@ def derive_metrics(
             _count(r.get("stragglers")) for r in completed
         ) / n_participants
 
-    for prefix, snap in (("", snapshot), ("fleet:", fleet_snapshot)):
+    for prefix, snap in (("", snapshot), ("fleet:", fleet_snapshot),
+                         ("edge:", edge_snapshot)):
         if not snap:
             continue
         for k, v in (snap.get("counters") or {}).items():
@@ -275,6 +278,66 @@ def check_baseline(
     return results
 
 
+def derive_bench_metrics(parsed: dict) -> "tuple[Dict[str, float], Dict[str, str]]":
+    """Flatten one ``bench.py`` output record into the flat SLO
+    namespace under a ``bench:`` prefix, so :func:`check_baseline` can
+    gate flagship performance the same way it gates scenario telemetry.
+
+    Returns ``(metrics, skips)``. A numeric field becomes
+    ``bench:<name>``; each ``flagship_mfu_recorded`` record becomes
+    ``bench:flagship:<model>:mfu`` / ``:rounds_per_sec``. A null
+    ``fused_rounds_per_sec`` / ``mfu`` with a recorded excuse
+    (``fused_skip_reason`` or ``degraded_reason``) lands in ``skips``
+    instead — visible and auditable; a null with NO recorded reason is
+    simply absent, which the baseline gate treats as a regression (the
+    BENCH_r03→r04 silent-drop class)."""
+    metrics: Dict[str, float] = {}
+    skips: Dict[str, str] = {}
+    for field in ("value", "rounds_per_sec", "dispatch_rounds_per_sec",
+                  "fused_rounds_per_sec", "mfu",
+                  "samples_per_sec_per_chip", "compile_s"):
+        v = parsed.get(field)
+        name = f"bench:{'rounds_per_sec' if field == 'value' else field}"
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            metrics[name] = float(v)
+        elif v is None and field in parsed:
+            reason = parsed.get("fused_skip_reason") or parsed.get(
+                "degraded_reason"
+            )
+            if reason:
+                skips[name] = str(reason)
+    flagship = parsed.get("flagship_mfu_recorded") or {}
+    for rec in flagship.get("records") or []:
+        model = rec.get("model")
+        if not model:
+            continue
+        for field in ("mfu", "rounds_per_sec", "tokens_per_sec_per_chip",
+                      "peak_hbm_gb"):
+            v = rec.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                metrics[f"bench:flagship:{model}:{field}"] = float(v)
+    return metrics, skips
+
+
+def check_bench_baseline(
+    baseline: dict, parsed: dict
+) -> "tuple[List[dict], Dict[str, str]]":
+    """Baseline-delta gate over one bench record. Same comparison rules
+    as :func:`check_baseline`, with one bench-specific carve-out: a
+    metric that is missing *with a recorded skip reason* reports
+    ``skipped`` instead of regressing — an unmeasured flagship number
+    must name why (accelerator probe failed, budget exhausted), or it
+    fails CI."""
+    metrics, skips = derive_bench_metrics(parsed)
+    results = check_baseline(baseline, metrics)
+    for entry in results:
+        reason = skips.get(entry["metric"])
+        if entry["regression"] and entry["observed"] is None and reason:
+            entry["regression"] = False
+            entry["note"] = f"skipped: {reason}"
+    return results, skips
+
+
 def evaluate_slo(
     slo: SLOSpec,
     records: List[dict],
@@ -282,6 +345,7 @@ def evaluate_slo(
     *,
     loadgen_snapshot: Optional[dict] = None,
     fleet_snapshot: Optional[dict] = None,
+    edge_snapshot: Optional[dict] = None,
     baseline: Optional[dict] = None,
     n_torn: int = 0,
     exclude_rounds: Iterable[str] = (),
@@ -296,7 +360,8 @@ def evaluate_slo(
     """
     excluded = set(exclude_rounds)
     kept = [r for r in records if r.get("round") not in excluded]
-    metrics = derive_metrics(kept, snapshot, loadgen_snapshot, fleet_snapshot)
+    metrics = derive_metrics(kept, snapshot, loadgen_snapshot,
+                             fleet_snapshot, edge_snapshot)
     assertions = check_assertions(slo.assertions, metrics)
 
     baseline_block = None

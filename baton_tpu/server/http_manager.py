@@ -77,6 +77,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
 import os
 import re
 import time
@@ -436,6 +437,11 @@ class Experiment:
         # (buffered compressed path) — treated like client_responses for
         # duplicate suppression
         self._accepting: set = set()
+        # (edge_client_id, update_id) pairs of edge partials already
+        # folded this round: the edge's at-least-once ship retries after
+        # a lost 200, and re-folding a cohort partial would double every
+        # contributor's weight at once
+        self._edge_partial_ids: set = set()
         self.checkpointer = None
         if checkpoint_dir is not None:
             from baton_tpu.utils.checkpoint import Checkpointer
@@ -1021,7 +1027,20 @@ class Experiment:
                 str(meta["update_id"]) if meta.get("update_id") else None
             )
             compressed = False
-            if meta.get("compressed"):
+            if meta.get("edge_partial") is not None:
+                # an edge aggregator's cohort partial: always a dense
+                # template-shaped mean (the edge refuses masked uploads
+                # and decompresses before folding). Shape-validate like
+                # a plain update; the secure/streaming 409s live in
+                # _ingest_edge_partial where they can be counted.
+                if not isinstance(meta["edge_partial"], dict):
+                    raise _BadUpload("Bad Edge Partial")
+                if meta.get("compressed") or meta.get("secure"):
+                    raise _BadUpload(
+                        "Edge Partial Cannot Be Compressed Or Masked"
+                    )
+                state_dict_to_params(self.params, tensors)
+            elif meta.get("compressed"):
                 if self.secure_agg:
                     # a sparse support set leaks which coordinates moved;
                     # masking needs dense ring elements (ops/compression.py)
@@ -1089,6 +1108,13 @@ class Experiment:
             # being cancelled as dropped — its late upload can no longer
             # be folded into the sum
             return web.json_response({"error": "Round Finalizing"}, status=410)
+        if isinstance(meta.get("edge_partial"), dict):
+            # a cohort partial from an edge aggregator — the edge itself
+            # is not a round participant, so this must branch before the
+            # cohort/participant 410s below
+            return await self._ingest_edge_partial(
+                client_id, tensors, meta, update_id
+            )
         if (
             self._secure_round is not None
             and client_id not in self._secure_round["cohort"]
@@ -1215,6 +1241,130 @@ class Experiment:
         self.rounds.client_end(client_id, response)
         self.registry.record_update(client_id, round_name)
         self.metrics.inc("updates_received")
+        self._maybe_finish()
+        return web.json_response("OK")
+
+    async def _ingest_edge_partial(
+        self, client_id: str, tensors: dict, meta: dict, update_id
+    ) -> web.Response:
+        """Merge one edge aggregator's cohort partial into the round.
+
+        The partial's tensors are the weighted mean over the edge's
+        cohort and ``edge_partial.contributors`` maps each worker to its
+        ``{n_samples, update_id, loss_history}``. Folding the mean back
+        with the summed weight reproduces the flat sequential fold
+        exactly (``StreamingMean`` is associative: ``mean × Σw`` is the
+        cohort's weighted sum), and crediting each contributor its own
+        per-worker response keeps loss-history aggregation, round
+        accounting, and worker dedup (a direct retry after a lost edge
+        ack) identical to the flat topology.
+
+        Refusals are 409s the edge/worker can act on: secure rounds need
+        direct uploads (a partial-folded masked update would break
+        unmasking — the masks only cancel in the full cohort sum), and
+        non-streaming aggregators need individual updates."""
+        round_name = meta.get("update_name")
+        info = meta["edge_partial"]
+        edge_name = str(info.get("edge") or client_id)
+        if self.secure_agg or self._secure_round is not None:
+            self.metrics.inc("updates_refused_edge_secure")
+            return web.json_response(
+                {"err": "Secure Round Requires Direct Uploads"}, status=409
+            )
+        acc = self._stream_acc
+        if acc is None:
+            # buffered/robust aggregators need the individual updates
+            self.metrics.inc("updates_refused_edge_unsupported")
+            return web.json_response(
+                {"err": "Edge Partials Require Streaming Aggregation"},
+                status=409,
+            )
+        if update_id is not None and (
+            (client_id, update_id) in self._edge_partial_ids
+        ):
+            self.metrics.inc("duplicate_updates_deduped")
+            return web.json_response("OK")
+        contributors = info.get("contributors")
+        if not isinstance(contributors, dict) or not contributors:
+            return web.json_response({"err": "Bad Edge Partial"}, status=400)
+        credited, total_w = [], 0.0
+        try:
+            parsed = [
+                (
+                    str(cid),
+                    float(c.get("n_samples", 0)),
+                    str(c["update_id"]) if c.get("update_id") else None,
+                    [float(x) for x in (c.get("loss_history") or [])],
+                )
+                for cid, c in sorted(contributors.items())
+            ]
+        except (AttributeError, TypeError, ValueError):
+            return web.json_response({"err": "Bad Edge Partial"}, status=400)
+        for cid, w, uid, losses in parsed:
+            if not (w > 0) or not math.isfinite(w):
+                return web.json_response(
+                    {"err": "Bad Edge Partial"}, status=400
+                )
+            # the weight ALWAYS counts toward the fold — it physically
+            # backs the partial's mean — but credit is conditional
+            total_w += w
+            if cid not in self.rounds.clients:
+                # unsampled (cohort_fraction < 1) or dropped mid-round
+                self.metrics.inc("edge_contributors_unknown")
+                continue
+            if cid in self.rounds.client_responses or cid in self._accepting:
+                # the worker also delivered direct (edge ack lost →
+                # direct retry race): its contribution is inside the
+                # partial's mean and cannot be subtracted, so the weight
+                # folds but the credit stays with the direct delivery
+                self.metrics.inc("edge_contributor_conflicts")
+                continue
+            credited.append((cid, w, uid, losses))
+        if total_w <= 0:
+            return web.json_response({"err": "Bad Edge Partial"}, status=400)
+        anchor = (
+            self._broadcast_anchor_sd
+            if self._broadcast_anchor_sd is not None
+            else params_to_state_dict(self.params)
+        )
+        # acceptance bookkeeping loop-atomically BEFORE the awaited fold
+        # (same contract as the direct streaming path): once the 200 is
+        # sent every credited contributor counts, and a racing duplicate
+        # — partial or direct — sees client_responses/_edge_partial_ids
+        if update_id is not None:
+            self._edge_partial_ids.add((client_id, update_id))
+        for cid, w, uid, losses in credited:
+            self.rounds.client_end(cid, {
+                "masked": False,
+                "n_samples": w,
+                "loss_history": losses,
+                "update_id": uid,
+                "streamed": True,
+                "via_edge": edge_name,
+            })
+            self.registry.record_update(cid, round_name)
+            self.metrics.inc("updates_received")
+            self.metrics.inc("edge_contributors_credited")
+        self.metrics.inc("updates_received_edge_partial")
+        shard = 0
+        if self.fold_shards > 1:
+            shard = self._fold_rr % self.fold_shards
+            self._fold_rr += 1
+        sharded = self.fold_shards > 1
+        fold_w = total_w
+
+        def fold():
+            payload = {k: tensors[k] for k in anchor}
+            if sharded:
+                acc.add(payload, fold_w, shard=shard)
+            else:
+                acc.add(payload, fold_w)
+
+        pipe = self._ingest
+        if pipe is not None:
+            await pipe.submit_fold(shard, fold)
+        else:
+            fold()
         self._maybe_finish()
         return web.json_response("OK")
 
@@ -1422,6 +1572,7 @@ class Experiment:
         self._chunks.clear()
         self.metrics.set_gauge("chunk_sessions_active", 0)
         self._fold_rr = 0
+        self._edge_partial_ids.clear()
         # _broadcasting must cover the WHOLE round setup — the secure
         # key/share phases included, not just the notify fan-out:
         # participants are only recorded at broadcast time, so a cull

@@ -50,6 +50,7 @@ import os
 import pathlib
 import random
 import secrets
+import time
 import weakref
 from typing import Callable, Optional, Tuple
 
@@ -91,6 +92,9 @@ class _PendingUpdate:
     body: bytes
     compressed_template: Optional[dict] = None
     attempts: int = 0
+    # masked (secure-aggregation) bodies are pinned to the direct root
+    # route: an edge partial-folding ring elements would break unmasking
+    masked: bool = False
 
 
 def _parse_compress(spec: Optional[str], seed: int = 0):
@@ -142,6 +146,8 @@ class ExperimentWorker:
         upload_chunk_bytes: Optional[int] = None,
         max_broadcast_bytes: Optional[int] = 1 << 30,
         train_time_scale: float = 1.0,
+        edge: Optional[str] = None,
+        edge_retry_s: float = 10.0,
     ):
         """``compress`` turns on sparse round-delta uploads
         (ops/compression.py): ``"topk:0.05"`` keeps the top 5% of delta
@@ -173,6 +179,18 @@ class ExperimentWorker:
         ``None`` disables the cap. Default 1 GiB — far above any real
         model push, low enough to bound a misbehaving peer.
 
+        ``edge``: ``"host:port"`` of an edge aggregator
+        (server/edge.py) to route control and data traffic through —
+        register, heartbeat, blob fetch, plain uploads, span shipping.
+        The edge serves round blobs from its local cache and folds the
+        cohort's updates into one upstream partial. On any transport
+        failure at the edge, the worker marks the route down for
+        ``edge_retry_s`` seconds and falls back DIRECT to the root
+        (credentials are root credentials either way — the edge only
+        proxies registration), so a dead edge degrades fan-in instead
+        of stalling rounds. Masked (secure-aggregation) uploads always
+        go direct regardless.
+
         ``train_time_scale``: simulated device-speed multiplier, >= 1.0.
         After real training finishes, the worker idles inside the
         ``local_train`` span until the round's compute has taken
@@ -202,7 +220,12 @@ class ExperimentWorker:
         self.port = port
         self.worker_host = worker_host
         self.manager = manager
-        self.manager_url = f"http://{manager}/{self.name}/"
+        self.root_url = f"http://{manager}/{self.name}/"
+        self.edge_url = f"http://{edge}/{self.name}/" if edge else None
+        self.edge_retry_s = float(edge_retry_s)
+        # monotonic deadline until which the edge route is considered
+        # down (0.0 = up); flipped by _edge_failed on transport errors
+        self._edge_down_until = 0.0
         self.allow_pickle = allow_pickle
         self.compressor = _parse_compress(compress, seed=rng_seed)
         self._round_anchor: Optional[dict] = None
@@ -311,6 +334,32 @@ class ExperimentWorker:
             self.__session = aiohttp.ClientSession()
         return self.__session
 
+    # -- hierarchical routing ------------------------------------------
+    def _via_edge(self) -> bool:
+        """True while control/data traffic should route through the
+        configured edge aggregator (configured AND not marked down)."""
+        return (
+            self.edge_url is not None
+            and time.monotonic() >= self._edge_down_until
+        )
+
+    @property
+    def manager_url(self) -> str:
+        """The current upstream base URL: the edge aggregator while that
+        route is healthy, the root manager otherwise. Re-evaluated per
+        attempt by every caller, so a mid-retry fallback takes effect on
+        the very next request."""
+        return self.edge_url if self._via_edge() else self.root_url
+
+    def _edge_failed(self) -> None:
+        """Mark the edge route down for ``edge_retry_s``: the next
+        attempt at any upstream call goes direct to the root (same
+        credentials — the edge only proxies registration)."""
+        if self.edge_url is None or not self._via_edge():
+            return
+        self._edge_down_until = time.monotonic() + self.edge_retry_s
+        self.metrics.inc("edge_route_fallbacks")
+
     # -- membership ----------------------------------------------------
     async def register_with_manager(self) -> None:
         if self._register_lock.locked():
@@ -319,10 +368,14 @@ class ExperimentWorker:
         # register attempt must wait out the whole handshake, not
         # interleave with it
         async with self._register_lock:  # batonlint: allow[BTL002]
-            url = self.manager_url + "register"
             payload = {"url": self.worker_host, "port": self.port}
             backoff = 1.0
             while True:
+                # URL per attempt: an edge failure mid-loop falls the
+                # next attempt back to the root (direct registration —
+                # the root then notifies this worker directly too)
+                via_edge = self._via_edge()
+                url = self.manager_url + "register"
                 try:
                     async with self._session.get(url, json=payload) as resp:
                         data = await resp.json()
@@ -331,6 +384,8 @@ class ExperimentWorker:
                         self.tracer.service = f"worker:{self.client_id}"
                         break
                 except aiohttp.ClientError:
+                    if via_edge:
+                        self._edge_failed()
                     await asyncio.sleep(backoff)
                     backoff = min(backoff * 2, MAX_BACKOFF)
             # (Re)start the heartbeat loop — unless we're being called
@@ -347,9 +402,12 @@ class ExperimentWorker:
                 ).start()
 
     async def heartbeat(self) -> None:
-        url = self.manager_url + "heartbeat"
         backoff = 1.0
         while True:
+            # URL per attempt, not once at the top: a dead edge marked
+            # down inside this loop must not pin every retry to it
+            via_edge = self._via_edge()
+            url = self.manager_url + "heartbeat"
             try:
                 # time only the round-trip: the 401 path's re-register
                 # (with its own retry backoff) would skew the histogram
@@ -365,7 +423,9 @@ class ExperimentWorker:
                     # manager restarted or culled us: rejoin
                     return await self.register_with_manager()
             except aiohttp.ClientError:
-                pass
+                if via_edge:
+                    self._edge_failed()
+                    continue  # retry direct immediately, no backoff
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, MAX_BACKOFF)
 
@@ -864,16 +924,21 @@ class ExperimentWorker:
     ) -> Optional[bytes]:
         """GET a content-addressed blob, resuming interrupted transfers
         with HTTP Range and verifying the assembled bytes by digest."""
-        url = (
-            self.manager_url
-            + f"round_blob/{digest}?client_id={self.client_id}&key={self.key}"
-        )
         buf = bytearray()
         base, cap = 0.2, 2.0
         with self.tracer.span(
             "fetch_blob", digest=digest[:12], size=size
         ) as fetch_sp:
             for attempt in range(max_attempts):
+                # URL per attempt: the blob is immutable and addressed
+                # by digest, so a resume that fell back from a dead edge
+                # to the root continues byte-for-byte where it stopped
+                via_edge = self._via_edge()
+                url = (
+                    self.manager_url
+                    + f"round_blob/{digest}"
+                    + f"?client_id={self.client_id}&key={self.key}"
+                )
                 headers = trace_headers()
                 if buf:
                     # the blob is immutable under its digest, so a partial
@@ -904,7 +969,9 @@ class ExperimentWorker:
                         else:
                             buf.clear()  # 416/401/5xx: restart clean
                 except (aiohttp.ClientError, asyncio.TimeoutError):
-                    pass  # partial body stays in buf; next attempt resumes
+                    # partial body stays in buf; next attempt resumes
+                    if via_edge:
+                        self._edge_failed()
                 if len(buf) == size:
                     if hashlib.sha256(buf).hexdigest() == digest:
                         fetch_sp.set(attempts=attempt + 1)
@@ -1190,6 +1257,7 @@ class ExperimentWorker:
                     if compressed_payload is not None
                     else None
                 ),
+                masked=st is not None,
             )
         )
 
@@ -1386,18 +1454,27 @@ class ExperimentWorker:
         # hours later (or after a crash-reload) still joins the right
         # trace, parented to the round's deterministic root span
         trace_id = tracing.make_trace_id(self.name, p.round_name)
+        # masked bodies always go direct: the edge cannot partial-fold
+        # ring elements (unmasking only works on the full cohort sum)
+        via_edge = self._via_edge() and not p.masked
+        base_url = self.edge_url if via_edge else self.root_url
         with self.tracer.span(
             "upload", trace_id=trace_id,
             parent_id=tracing.root_span_id(trace_id),
             round=p.round_name, bytes=len(p.body),
             attempt=p.attempts + 1, chunked=chunked,
+            via_edge=via_edge,
         ) as up_sp:
             if chunked:
-                status, retry_after = await self._post_update_chunked(p)
+                status, retry_after = await self._post_update_chunked(
+                    p, base_url
+                )
                 up_sp.set(status=status)
+                if status is None and via_edge:
+                    self._edge_failed()
                 return status, retry_after
             url = (
-                self.manager_url
+                base_url
                 + f"update?client_id={self.client_id}&key={self.key}"
             )
             try:
@@ -1408,14 +1485,21 @@ class ExperimentWorker:
                     ),
                 ) as resp:
                     up_sp.set(status=resp.status)
+                    if resp.status == 409 and via_edge:
+                        # the edge refused to fold (secure round, round
+                        # unknown): mark the route down so the outbox's
+                        # next attempt delivers direct to the root
+                        self._edge_failed()
                     return resp.status, self._retry_after_s(resp)
             except (aiohttp.ClientError, asyncio.TimeoutError):
                 # manager down; the backoff loop keeps trying
                 up_sp.set(status=None)
+                if via_edge:
+                    self._edge_failed()
                 return None, None
 
     async def _post_update_chunked(
-        self, p: _PendingUpdate
+        self, p: _PendingUpdate, base_url: Optional[str] = None
     ) -> Tuple[Optional[int], Optional[float]]:
         """Deliver one update as offset/total-framed PUT chunks.
 
@@ -1427,7 +1511,7 @@ class ExperimentWorker:
         update's acceptance ack."""
         total = len(p.body)
         base = (
-            self.manager_url
+            (base_url if base_url is not None else self.manager_url)
             + f"update_chunk/{p.update_id}"
             + f"?client_id={self.client_id}&key={self.key}"
         )

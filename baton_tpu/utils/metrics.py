@@ -89,6 +89,33 @@ DECLARED_COUNTERS = frozenset({
     # worker: trace shipping
     "trace_spans_shipped",
     "trace_ship_failed",
+    # manager: hierarchical aggregation (edge partial merge)
+    "updates_received_edge_partial",
+    "edge_contributors_credited",
+    "edge_contributor_conflicts",
+    "edge_contributors_unknown",
+    "updates_refused_edge_secure",
+    "updates_refused_edge_unsupported",
+    # edge aggregator (server/edge.py)
+    "edge_registers_proxied",
+    "edge_heartbeats_proxied",
+    "edge_relay_notifies",
+    "edge_relay_failed",
+    "edge_blob_hits",
+    "edge_blob_fetches",
+    "edge_blob_fetch_failed",
+    "edge_bytes_served",
+    "edge_bytes_fetched",
+    "edge_range_resumes",
+    "edge_updates_folded",
+    "edge_updates_proxied",
+    "edge_updates_refused_secure",
+    "edge_partials_shipped",
+    "edge_partial_ship_failed",
+    "edge_partial_refused",
+    "edge_partials_abandoned",
+    # worker: edge routing fallback
+    "edge_route_fallbacks",
     # loadgen: open-loop scenario driver (baton_tpu/loadgen/engine.py)
     "scenario_rounds_started",
     "scenario_rounds_refused_423",
@@ -97,6 +124,8 @@ DECLARED_COUNTERS = frozenset({
     "scenario_workers_joined",
     "scenario_workers_left",
     "scenario_warmup_rounds",
+    "scenario_edges_started",
+    "scenario_edges_killed",
 })
 
 DECLARED_COUNTER_PREFIXES = (
@@ -114,6 +143,10 @@ DECLARED_TIMERS = frozenset({
     "ingest_fold_s",    # manager: per-shard streaming fold
     "heartbeat_s",      # worker: heartbeat GET round-trip
     "loop_lag_s",       # both: event-loop scheduling delay (LoopLagProbe)
+    # edge aggregator (server/edge.py)
+    "edge_blob_fetch_s",    # edge: root blob fetch on cohort cache miss
+    "edge_partial_ship_s",  # edge: partial upload to root, end to end
+    "edge_relay_s",         # edge: root→worker notify/secure relay hop
 })
 
 # Gauges set under baton_tpu/server/ (BTL030 audits .set_gauge() names).
@@ -133,6 +166,10 @@ DECLARED_GAUGES = frozenset({
     "outbox_pending",
     "train_epoch",
     "train_epoch_loss",
+    # edge aggregator (server/edge.py)
+    "edge_cohort_size",
+    "edge_round_pending",
+    "edge_cache_bytes",
     # both: LoopLagProbe scheduling-delay gauge
     "loop_lag_s",
     # loadgen: scenario driver state

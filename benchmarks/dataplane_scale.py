@@ -14,6 +14,14 @@ keep their previous numbers in the JSON):
 * ``resume`` — a ~100 MB chunked upload killed at ~90% by a transport
   drop, then resumed by a fresh worker from the manager's committed
   offset; reports the fraction of the body transferred twice.
+* ``edge`` — flat (every worker direct to root) vs a hierarchical tier
+  of edge aggregators at the same cohort size: each edge fetches the
+  round blob from the root once and serves its cohort from cache, folds
+  cohort updates into one weighted partial, and ships that upstream.
+  Reports root downlink bytes/round for both topologies (the reduction
+  factor is the point), heartbeat p50/p95 through each route, root and
+  edge ingest-fold percentiles, and verifies the edge-tier aggregate
+  equals the flat fold within streaming-mean tolerance.
 
 What runs: a manager with ``broadcast_delta`` on and C ``EchoWorker``s
 (no jit training — each "round" perturbs local params slightly so every
@@ -64,8 +72,10 @@ from aiohttp import web  # noqa: E402
 
 from baton_tpu.models.linear import linear_regression_model  # noqa: E402
 from baton_tpu.server import wire  # noqa: E402
+from baton_tpu.server.edge import EdgeAggregator  # noqa: E402
 from baton_tpu.server.http_manager import Manager  # noqa: E402
 from baton_tpu.server.http_worker import ExperimentWorker  # noqa: E402
+from baton_tpu.server.topology import EdgeTopology  # noqa: E402
 from baton_tpu.server.state import (  # noqa: E402
     params_to_state_dict,
     state_dict_to_params,
@@ -486,8 +496,224 @@ async def _resume_section(resume_mb: int, chunk_mb: int) -> dict:
     return out
 
 
+async def _edge_topology_once(
+    c: int, dim: int, n_edges: int, rounds: int
+) -> tuple:
+    """One topology configuration: C EchoWorkers either direct to the
+    root (``n_edges=0``) or sharded over ``n_edges`` edge aggregators by
+    the consistent-hash topology. Drives ``rounds`` rounds, runs a
+    heartbeat probe through worker 0's route (root or its edge — the
+    probe latency is what a worker actually sees), and returns
+    ``(stats, final_state_dict)`` so the caller can compare aggregates
+    across configurations bit-for-bit."""
+    import aiohttp
+
+    model = linear_regression_model(dim, name="edgebench")
+    mport = _free_port()
+    mapp = web.Application()
+    exp = Manager(mapp).register_experiment(
+        model, name="edgebench", round_timeout=600.0,
+    )
+    mrunner = web.AppRunner(mapp)
+    await mrunner.setup()
+    await web.TCPSite(mrunner, "127.0.0.1", mport).start()
+
+    runners = [mrunner]
+    edge_metrics = Metrics()
+    edge_ports = {}
+    topo = None
+    if n_edges:
+        topo = EdgeTopology([f"e{i}" for i in range(n_edges)])
+        for i in range(n_edges):
+            eport = _free_port()
+            eapp = web.Application()
+            EdgeAggregator(
+                eapp, f"127.0.0.1:{mport}", name="edgebench", port=eport,
+                edge_name=f"e{i}", ship_settle_s=0.25, flush_after_s=60.0,
+                heartbeat_time=120.0, metrics=edge_metrics,
+            )
+            erunner = web.AppRunner(eapp)
+            await erunner.setup()
+            await web.TCPSite(erunner, "127.0.0.1", eport).start()
+            edge_ports[f"e{i}"] = eport
+            runners.append(erunner)
+
+    workers, ack_log = [], []
+    for i in range(c):
+        wport = _free_port()
+        wapp = web.Application()
+        route = None
+        if topo is not None:
+            route = f"127.0.0.1:{edge_ports[topo.assign(f'w{i}')]}"
+        w = EchoWorker(
+            wapp, model, f"127.0.0.1:{mport}", name="edgebench",
+            port=wport, heartbeat_time=120.0, ack_log=ack_log,
+            noise_seed=i, get_data=lambda: ({}, 32), edge=route,
+        )
+        wrunner = web.AppRunner(wapp)
+        await wrunner.setup()
+        await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+        workers.append(w)
+        runners.append(wrunner)
+    # each edge registers at the root as a client of its own
+    expect = c + n_edges
+    for _ in range(1200):
+        if len(exp.registry) == expect:
+            break
+        await asyncio.sleep(0.05)
+    assert len(exp.registry) == expect, \
+        f"registered {len(exp.registry)}/{expect}"
+
+    full_size = len(wire.encode(
+        {k: np.ascontiguousarray(np.asarray(v))
+         for k, v in params_to_state_dict(exp.params).items()}, {}))
+
+    bench = Metrics()
+    lag_probe = LoopLagProbe(bench, interval=0.05)
+    lag_probe.start()
+    stop = asyncio.Event()
+    timeout = aiohttp.ClientTimeout(total=600.0)
+    session = aiohttp.ClientSession(timeout=timeout)
+    w0 = workers[0]
+    probe_base = w0.edge_url or f"http://127.0.0.1:{mport}/edgebench/"
+
+    async def probe():
+        hb_json = {"client_id": w0.client_id, "key": w0.key}
+        while not stop.is_set():
+            with bench.timer("heartbeat_s"):
+                async with session.get(
+                    f"{probe_base}heartbeat", json=hb_json
+                ) as r:
+                    assert r.status == 200
+            await asyncio.sleep(0.005)
+
+    probe_task = asyncio.ensure_future(probe())
+    per_round = []
+    for r in range(rounds):
+        before = exp.metrics.snapshot()["counters"]
+        ack_log.clear()
+        t0 = time.perf_counter()
+        async with session.get(
+            f"http://127.0.0.1:{mport}/edgebench/start_round?n_epoch=1"
+        ) as resp:
+            assert resp.status == 200
+        for _ in range(12000):
+            if not exp.rounds.in_progress:
+                break
+            await asyncio.sleep(0.05)
+        assert not exp.rounds.in_progress, f"round {r} hung"
+        after = exp.metrics.snapshot()["counters"]
+        round_hist = Metrics()
+        for t in ack_log:
+            round_hist.observe("notify_ack_s", t - t0)
+        ack_stats = _timer_stats(round_hist, "notify_ack_s")
+        per_round.append({
+            "round": r,
+            "root_bytes_down": after.get("bytes_broadcast", 0.0)
+            - before.get("bytes_broadcast", 0.0),
+            "root_bytes_up": after.get("bytes_uploaded", 0.0)
+            - before.get("bytes_uploaded", 0.0),
+            "edge_partials": after.get("updates_received_edge_partial", 0.0)
+            - before.get("updates_received_edge_partial", 0.0),
+            "acks": ack_stats["count"],
+            "notify_ack_p50_s": ack_stats["p50_s"],
+            "notify_ack_p95_s": ack_stats["p95_s"],
+            "round_wall_s": time.perf_counter() - t0,
+        })
+        print(f"[edge n={n_edges}] round {r}: "
+              f"root_down={per_round[-1]['root_bytes_down']:.0f}B "
+              f"ack_p95={per_round[-1]['notify_ack_p95_s']:.3f}s "
+              f"wall={per_round[-1]['round_wall_s']:.2f}s",
+              file=sys.stderr, flush=True)
+
+    stop.set()
+    await probe_task
+    lag_probe.stop()
+    snap = exp.metrics.snapshot()["counters"]
+    assert snap.get("updates_received", 0) == c * rounds
+    assert snap.get("updates_received_edge_partial", 0) == n_edges * rounds
+    final_sd = {k: np.asarray(v, np.float32)
+                for k, v in params_to_state_dict(exp.params).items()}
+    await session.close()
+    for rn in runners:
+        await rn.cleanup()
+
+    hb = _timer_stats(bench, "heartbeat_s")
+    lag = _timer_stats(bench, "loop_lag_s")
+    esnap = edge_metrics.snapshot()["counters"] if n_edges else {}
+    stats = {
+        "n_edges": n_edges,
+        "cohort": c,
+        "full_blob_bytes": full_size,
+        "root_bytes_down_per_round":
+            sum(p["root_bytes_down"] for p in per_round) / len(per_round),
+        "heartbeat_p50_s": hb["p50_s"],
+        "heartbeat_p95_s": hb["p95_s"],
+        "heartbeat_samples": hb["count"],
+        "root_ingest_fold": _timer_stats(exp.metrics, "ingest_fold_s"),
+        "root_ingest_decode": _timer_stats(exp.metrics, "ingest_decode_s"),
+        "loop_lag_p95_s": lag["p95_s"],
+        "loop_lag_max_s": lag["max_s"],
+        "rounds": per_round,
+    }
+    if n_edges:
+        stats["edge_ingest_fold"] = _timer_stats(
+            edge_metrics, "ingest_fold_s")
+        stats["edge_counters"] = {
+            k: esnap.get(k, 0.0)
+            for k in ("edge_blob_fetches", "edge_blob_hits",
+                      "edge_updates_folded", "edge_partials_shipped",
+                      "edge_registers_proxied", "edge_relay_notifies")
+        }
+    return stats, final_sd
+
+
+async def _edge_section(c: int, dim: int, n_edges: int, rounds: int) -> dict:
+    """Flat vs ``n_edges``-edge hierarchy at the same cohort size. The
+    two runs are seeded identically (same model init, same per-worker
+    noise streams), so the final root aggregates must agree within
+    streaming-mean float tolerance — the associativity claim the edge
+    tier rests on, checked here at benchmark scale too, not just in
+    tests."""
+    print(f"[edge] C={c}, flat (direct to root)...",
+          file=sys.stderr, flush=True)
+    flat, flat_sd = await _edge_topology_once(c, dim, 0, rounds)
+    print(f"[edge] C={c}, {n_edges} edge aggregators...",
+          file=sys.stderr, flush=True)
+    edged, edge_sd = await _edge_topology_once(c, dim, n_edges, rounds)
+
+    max_abs_diff = max(
+        float(np.max(np.abs(flat_sd[k] - edge_sd[k]))) for k in flat_sd)
+    agg_equal = all(
+        np.allclose(flat_sd[k], edge_sd[k], rtol=1e-4, atol=1e-6)
+        for k in flat_sd)
+    reduction = flat["root_bytes_down_per_round"] / max(
+        edged["root_bytes_down_per_round"], 1.0)
+    assert agg_equal, \
+        f"edge aggregate diverged from flat fold (max |d|={max_abs_diff})"
+    assert reduction >= 3.0, \
+        f"root downlink reduction {reduction:.1f}x < 3x"
+    out = {
+        "cohort": c,
+        "model_dim": dim,
+        "n_edges": n_edges,
+        "rounds_per_config": rounds,
+        "flat": flat,
+        "edged": edged,
+        "root_downlink_reduction_x": reduction,
+        "aggregate_max_abs_diff": max_abs_diff,
+        "aggregate_allclose": agg_equal,
+    }
+    print(f"[edge] root downlink {flat['root_bytes_down_per_round']:.0f}B "
+          f"-> {edged['root_bytes_down_per_round']:.0f}B per round "
+          f"({reduction:.1f}x), aggregate max |d|={max_abs_diff:.2e}",
+          file=sys.stderr, flush=True)
+    return out
+
+
 async def _main(cohorts, dim, rounds, spec, sections, uplink_cohort,
-                uplink_dim, resume_mb, chunk_mb, prior) -> dict:
+                uplink_dim, resume_mb, chunk_mb, edge_cohort, edge_count,
+                edge_rounds, prior) -> dict:
     out = {
         "benchmark": "dataplane_scale",
         "delta_spec": spec,
@@ -499,6 +725,7 @@ async def _main(cohorts, dim, rounds, spec, sections, uplink_cohort,
         "results": prior.get("results", []),
         "uplink": prior.get("uplink"),
         "chunk_resume": prior.get("chunk_resume"),
+        "edge_topology": prior.get("edge_topology"),
     }
     if "downlink" in sections:
         out["results"] = []
@@ -508,6 +735,9 @@ async def _main(cohorts, dim, rounds, spec, sections, uplink_cohort,
         out["uplink"] = await _uplink_section(uplink_cohort, uplink_dim)
     if "resume" in sections:
         out["chunk_resume"] = await _resume_section(resume_mb, chunk_mb)
+    if "edge" in sections:
+        out["edge_topology"] = await _edge_section(
+            edge_cohort, dim, edge_count, edge_rounds)
     return out
 
 
@@ -518,13 +748,17 @@ if __name__ == "__main__":
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--delta-spec", default="topk:0.05:q8")
     ap.add_argument("--sections", default="downlink,uplink,resume",
-                    help="comma list; skipped sections keep the previous "
-                         "JSON's numbers")
+                    help="comma list of downlink,uplink,resume,edge; "
+                         "skipped sections keep the previous JSON's "
+                         "numbers")
     ap.add_argument("--uplink-cohort", type=int, default=64)
     ap.add_argument("--uplink-dim", type=int, default=1048576,
                     help="model dim for the uplink burst (~4MB/update)")
     ap.add_argument("--resume-mb", type=int, default=100)
     ap.add_argument("--chunk-mb", type=int, default=4)
+    ap.add_argument("--edge-cohort", type=int, default=256)
+    ap.add_argument("--edge-count", type=int, default=4)
+    ap.add_argument("--edge-rounds", type=int, default=2)
     ap.add_argument(
         "--out",
         default=os.path.join(os.path.dirname(__file__),
@@ -543,7 +777,7 @@ if __name__ == "__main__":
     result = asyncio.run(_main(
         cohorts, args.dim, args.rounds, args.delta_spec, sections,
         args.uplink_cohort, args.uplink_dim, args.resume_mb, args.chunk_mb,
-        prior,
+        args.edge_cohort, args.edge_count, args.edge_rounds, prior,
     ))
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
@@ -564,4 +798,12 @@ if __name__ == "__main__":
               f"{100 * cr['killed_at_fraction']:.0f}%, retransferred "
               f"{100 * cr['retransfer_fraction']:.1f}% of "
               f"{cr['body_bytes'] / 1e6:.0f}MB")
+    if result.get("edge_topology"):
+        et = result["edge_topology"]
+        print(f"edge C={et['cohort']}: root downlink "
+              f"{et['flat']['root_bytes_down_per_round'] / 1e6:.1f}MB -> "
+              f"{et['edged']['root_bytes_down_per_round'] / 1e6:.2f}MB "
+              f"per round ({et['root_downlink_reduction_x']:.1f}x, "
+              f"{et['n_edges']} edges), aggregate max "
+              f"|d|={et['aggregate_max_abs_diff']:.2e}")
     print(f"wrote {args.out}")

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Gate flagship bench numbers against a committed baseline.
+
+    python scripts/check_bench_slo.py --latest
+    python scripts/check_bench_slo.py BENCH_r05.json
+
+Reads one ``bench.py`` output record — either a raw record or a
+``BENCH_rNN.json`` wrapper (its ``parsed`` block) — flattens it into the
+``bench:`` SLO namespace (:func:`baton_tpu.loadgen.slo.derive_bench_metrics`)
+and runs the same baseline-delta comparison the scenario gate uses, so a
+BENCH_r03→r04-class perf dip (``fused_rounds_per_sec`` silently becoming
+null, a flagship MFU sliding) fails CI instead of waiting for a
+reviewer's eyeball. A number that is missing *with a recorded skip
+reason* (``fused_skip_reason`` / ``degraded_reason``) reports as skipped
+— unmeasured must name why; unmeasured without a reason regresses.
+
+Exit codes: 0 pass, 1 regression, 2 config/input error.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_BASELINE = os.path.join("benchmarks", "baselines",
+                                "bench_flagship.json")
+
+
+def _latest_bench(root: str) -> str:
+    cands = glob.glob(os.path.join(root, "BENCH_r*.json"))
+    if not cands:
+        raise FileNotFoundError(f"no BENCH_r*.json under {root}")
+
+    def key(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    return max(cands, key=key)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/check_bench_slo.py",
+        description="flagship bench baseline-delta gate",
+    )
+    ap.add_argument("bench", nargs="?", default=None,
+                    help="bench output JSON (raw record or BENCH_rNN wrapper)")
+    ap.add_argument("--latest", action="store_true",
+                    help="gate the highest-numbered BENCH_r*.json in the "
+                         "repo root")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--report", default=None,
+                    help="write the full delta report JSON here")
+    args = ap.parse_args(argv)
+
+    from baton_tpu.loadgen.slo import check_bench_baseline, load_baseline
+    from baton_tpu.loadgen.scenario import ScenarioError
+
+    try:
+        path = args.bench or (_latest_bench(".") if args.latest else None)
+        if path is None:
+            ap.error("pass a bench JSON or --latest")
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+        parsed = data.get("parsed") if isinstance(data.get("parsed"), dict) \
+            else data
+        baseline = load_baseline(args.baseline)
+    except (OSError, ValueError, ScenarioError) as exc:
+        print(f"bench gate error: {exc}", file=sys.stderr)
+        return 2
+
+    results, skips = check_bench_baseline(baseline, parsed)
+    regressions = [r for r in results if r["regression"]]
+    report = {
+        "bench": path,
+        "baseline": args.baseline,
+        "regressions": len(regressions),
+        "results": results,
+        "skips": skips,
+    }
+    if args.report:
+        os.makedirs(os.path.dirname(os.path.abspath(args.report)),
+                    exist_ok=True)
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2)
+            fh.write("\n")
+
+    verdict = "PASS" if not regressions else "FAIL"
+    print(f"[{verdict}] bench={path} baseline={args.baseline} "
+          f"checked={len(results)} regressions={len(regressions)} "
+          f"skipped={sum(1 for r in results if 'skipped' in (r.get('note') or ''))}")
+    for r in results:
+        note = r.get("note")
+        if r["regression"]:
+            print(f"  regression: {r['metric']} baseline={r['baseline']} "
+                  f"observed={r['observed']} ({note or 'beyond tolerance'})")
+        elif note:
+            print(f"  {r['metric']}: {note}")
+    return 0 if not regressions else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,19 +1,23 @@
-"""CI smoke round with distributed tracing: manager + 2 in-process
-workers over real loopback sockets, one federated round end to end,
-then export the round's trace and SLO record as build artifacts.
+"""CI smoke round with distributed tracing: one root manager, two edge
+aggregators, and 2 in-process workers (one per edge) over real loopback
+sockets, one federated round end to end, then export the round's merged
+trace and SLO record as build artifacts.
 
 Artifacts (``--artifacts DIR``, default ``./artifacts``):
 
 * ``round_trace.json``  — Chrome ``trace_event`` export of the round
-  (drop it into Perfetto / chrome://tracing);
+  (drop it into Perfetto / chrome://tracing); spans from all THREE
+  tiers — manager, edges, workers — merged by traceparent;
 * ``rounds.jsonl``      — the per-round SLO records;
 * ``manager_metrics.json`` — the manager's full metrics snapshot
-  (histogram timers with p50/p95/p99).
+  (histogram timers with p50/p95/p99);
+* ``edge_metrics.json`` — both edges' metrics snapshots.
 
-Exits non-zero if the round fails, the trace is missing spans from
-either side of the federation, or the SLO record is absent — so a CI
-run that silently breaks traceparent propagation fails here rather
-than in a dashboard weeks later.
+Exits non-zero if the round fails, the trace is missing spans from any
+tier of the federation (the edge hop must carry the traceparent both
+ways), or the SLO record is absent — so a CI run that silently breaks
+traceparent propagation fails here rather than in a dashboard weeks
+later.
 
 Run locally:  JAX_PLATFORMS=cpu python scripts/smoke_trace.py
 """
@@ -36,6 +40,7 @@ from aiohttp import web  # noqa: E402
 from baton_tpu.core.training import make_local_trainer  # noqa: E402
 from baton_tpu.data.synthetic import linear_client_data  # noqa: E402
 from baton_tpu.models.linear import linear_regression_model  # noqa: E402
+from baton_tpu.server.edge import EdgeAggregator  # noqa: E402
 from baton_tpu.server.http_manager import Manager  # noqa: E402
 from baton_tpu.server.http_worker import ExperimentWorker  # noqa: E402
 from baton_tpu.utils.slog import setup_json_logging  # noqa: E402
@@ -72,13 +77,31 @@ async def _smoke(artifacts: str) -> int:
     await mrunner.setup()
     await web.TCPSite(mrunner, "127.0.0.1", mport).start()
 
+    # two edge aggregators between the workers and the root: the round
+    # must traverse the full hierarchy (notify relay down, blob cache
+    # serve, partial fold + ship up) with the traceparent intact
+    runners = [mrunner]
+    edges = []
+    for i in range(2):
+        eport = _free_port()
+        eapp = web.Application()
+        edge = EdgeAggregator(
+            eapp, f"127.0.0.1:{mport}", name=name, port=eport,
+            edge_name=f"e{i}", ship_settle_s=0.05, heartbeat_time=5.0,
+        )
+        erunner = web.AppRunner(eapp)
+        await erunner.setup()
+        await web.TCPSite(erunner, "127.0.0.1", eport).start()
+        edges.append(edge)
+        runners.append(erunner)
+
     trainer = make_local_trainer(linear_regression_model(dim),
                                  batch_size=32, learning_rate=0.02)
     nprng = np.random.default_rng(0)
-    workers, runners = [], [mrunner]
+    workers = []
     # one plain worker, one chunk-uploading worker — both upload paths
-    # must carry the traceparent
-    for chunk in (None, 1 << 12):
+    # must carry the traceparent; each routes through its own edge
+    for i, chunk in enumerate((None, 1 << 12)):
         wport = _free_port()
         data = linear_client_data(nprng, min_batches=2, max_batches=2)
         wapp = web.Application()
@@ -89,6 +112,7 @@ async def _smoke(artifacts: str) -> int:
             get_data=lambda d=data: (d, d["x"].shape[0]),
             outbox_backoff=(0.05, 0.4),
             upload_chunk_bytes=chunk,
+            edge=f"127.0.0.1:{edges[i].port}",
         )
         wrunner = web.AppRunner(wapp)
         await wrunner.setup()
@@ -98,8 +122,9 @@ async def _smoke(artifacts: str) -> int:
 
     ok = True
     try:
-        assert await _wait(lambda: len(exp.registry) == 2), \
-            "workers did not register"
+        # 2 workers + 2 edges (each edge holds a client entry of its own)
+        assert await _wait(lambda: len(exp.registry) == 4), \
+            "workers/edges did not register"
         async with aiohttp.ClientSession() as session:
             async with session.get(
                 f"http://127.0.0.1:{mport}/{name}/start_round?n_epoch=2"
@@ -129,6 +154,10 @@ async def _smoke(artifacts: str) -> int:
         with open(os.path.join(artifacts, "manager_metrics.json"),
                   "w") as fh:
             json.dump(metrics, fh, indent=2)
+        with open(os.path.join(artifacts, "edge_metrics.json"),
+                  "w") as fh:
+            json.dump({e.edge_name: e.metrics.snapshot() for e in edges},
+                      fh, indent=2)
 
         services = {
             e["args"]["name"]
@@ -139,9 +168,18 @@ async def _smoke(artifacts: str) -> int:
         }
         assert any(s.startswith("manager#") for s in services), services
         assert sum(s.startswith("worker:") for s in services) == 2, services
+        assert sum(s.startswith("edge:") for s in services) == 2, services
         for want in ("round", "round_setup", "notify", "local_train",
-                     "upload", "ingest", "aggregate"):
+                     "upload", "ingest", "aggregate", "edge_relay",
+                     "edge_partial_upload"):
             assert want in span_names, (want, span_names)
+        mc = metrics["counters"]
+        assert mc.get("updates_received_edge_partial") == 2, mc
+        assert mc.get("updates_received") == 2, mc
+        for e in edges:
+            ec = e.metrics.snapshot()["counters"]
+            assert ec.get("edge_partials_shipped") == 1, (e.edge_name, ec)
+            assert ec.get("edge_updates_folded") == 1, (e.edge_name, ec)
         for tname, st in metrics["timers"].items():
             assert {"p50_s", "p95_s", "p99_s"} <= set(st), tname
         with open(rounds_path) as fh:

@@ -1,0 +1,420 @@
+"""Hierarchical aggregation tier: consistent-hash topology units, a
+full round through an edge aggregator (register/notify/blob/fold/ship
+all via the edge hop), the secure-aggregation guards on both tiers,
+and the chaos path — an edge killed mid-round with the cohort's
+updates landing at the root via the direct fallback route.
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from baton_tpu.core.training import make_local_trainer
+from baton_tpu.data.synthetic import linear_client_data
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.ops import aggregation as agg
+from baton_tpu.server import wire
+from baton_tpu.server.edge import EdgeAggregator, _WorkerRoute
+from baton_tpu.server.http_manager import Manager
+from baton_tpu.server.http_worker import ExperimentWorker
+from baton_tpu.server.state import params_to_state_dict
+from baton_tpu.server.topology import EdgeTopology
+
+from conftest import counter
+
+
+def free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+WORKERS = [f"w{i}" for i in range(64)]
+
+
+# ----------------------------------------------------------------------
+# topology: consistent-hash assignment
+
+
+def test_topology_validation():
+    with pytest.raises(ValueError):
+        EdgeTopology(["a", "a"])
+    with pytest.raises(ValueError):
+        EdgeTopology(["a"], replicas=0)
+    with pytest.raises(KeyError):
+        EdgeTopology(["a"]).mark_dead("nope")
+    with pytest.raises(KeyError):
+        EdgeTopology(["a"]).mark_alive("nope")
+
+
+def test_topology_deterministic_and_covering():
+    a = EdgeTopology(["e0", "e1", "e2", "e3"])
+    b = EdgeTopology(["e3", "e1", "e0", "e2"])  # order-insensitive
+    for w in WORKERS:
+        assert a.assign(w) == b.assign(w)
+        assert a.assign(w) in {"e0", "e1", "e2", "e3"}
+    cohorts = a.cohorts(WORKERS)
+    # a partition: every worker lands in exactly one cohort …
+    assert sorted(sum(cohorts.values(), [])) == sorted(WORKERS)
+    # … and with 128 vnodes per edge, none of the 4 edges sits empty
+    assert len(cohorts) == 4 and all(cohorts.values())
+
+
+def test_topology_minimal_disruption_on_edge_death():
+    topo = EdgeTopology(["e0", "e1", "e2", "e3"])
+    before = {w: topo.assign(w) for w in WORKERS}
+    topo.mark_dead("e1")
+    assert topo.live_edges() == ["e0", "e2", "e3"]
+    assert not topo.is_live("e1")
+    moved = 0
+    for w in WORKERS:
+        now = topo.assign(w)
+        assert now != "e1"
+        if before[w] == "e1":
+            moved += 1
+        else:
+            # the defining property: only the dead edge's workers move
+            assert now == before[w]
+    assert moved == sum(1 for e in before.values() if e == "e1") > 0
+    # revival restores the exact original mapping
+    topo.mark_alive("e1")
+    assert {w: topo.assign(w) for w in WORKERS} == before
+
+
+def test_topology_all_dead_degrades_to_direct():
+    topo = EdgeTopology(["e0", "e1"])
+    topo.mark_dead("e0")
+    topo.mark_dead("e1")
+    assert topo.assign("w0") is None
+    assert topo.cohorts(["w0", "w1"]) == {None: ["w0", "w1"]}
+    assert EdgeTopology([]).assign("w0") is None
+
+
+# ----------------------------------------------------------------------
+# HTTP harness
+
+
+async def _start_app(app):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    port = free_port()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    return runner, port
+
+
+async def _wait_for(predicate, timeout_s=15.0, interval=0.05):
+    for _ in range(int(timeout_s / interval)):
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+class _GatedTrainer:
+    """Delegating trainer that blocks in ``train`` (called inside
+    ``asyncio.to_thread``) until the test opens the gate — the
+    deterministic window in which to kill an edge mid-round."""
+
+    def __init__(self, inner, gate):
+        self.inner = inner
+        self.gate = gate
+        self.batch_size = inner.batch_size
+
+    def train(self, *args, **kwargs):
+        assert self.gate.wait(timeout=30), "chaos gate never opened"
+        return self.inner.train(*args, **kwargs)
+
+
+async def _build_tier(model, trainer, nprng, n_workers=2, gate=None,
+                      name="ed"):
+    """Root manager + one edge + ``n_workers`` workers routed through
+    it. Returns (exp, edge, workers, runners) — runners in teardown
+    order (workers first, then edge, then root)."""
+    mapp = web.Application()
+    exp = Manager(mapp).register_experiment(
+        model, name=name, round_timeout=60.0, client_ttl=300.0,
+    )
+    mrunner, mport = await _start_app(mapp)
+
+    eapp = web.Application()
+    eport = free_port()
+    edge = EdgeAggregator(
+        eapp, f"127.0.0.1:{mport}", name=name, port=eport,
+        edge_name="e0", ship_settle_s=0.05, flush_after_s=15.0,
+        heartbeat_time=5.0,
+    )
+    erunner = web.AppRunner(eapp)
+    await erunner.setup()
+    await web.TCPSite(erunner, "127.0.0.1", eport).start()
+
+    if gate is not None:
+        trainer = _GatedTrainer(trainer, gate)
+
+    workers, runners = [], []
+    for _ in range(n_workers):
+        data = linear_client_data(nprng, min_batches=2, max_batches=2)
+        wapp = web.Application()
+        w = ExperimentWorker(
+            wapp, model, f"127.0.0.1:{mport}", name=name,
+            port=free_port(), heartbeat_time=30.0, trainer=trainer,
+            get_data=lambda d=data: (d, d["x"].shape[0]),
+            edge=f"127.0.0.1:{eport}", edge_retry_s=30.0,
+            outbox_backoff=(0.1, 0.5),
+        )
+        wrunner = web.AppRunner(wapp)
+        await wrunner.setup()
+        await web.TCPSite(wrunner, "127.0.0.1", w.port).start()
+        workers.append(w)
+        runners.append(wrunner)
+    ok = await _wait_for(lambda: len(exp.registry) == n_workers + 1)
+    assert ok, "workers + edge failed to register"
+    return exp, edge, workers, runners + [erunner, mrunner], mport, erunner
+
+
+async def _drive_round(mport, name, exp, n_epoch=1):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        async with s.get(
+            f"http://127.0.0.1:{mport}/{name}/start_round?n_epoch={n_epoch}"
+        ) as resp:
+            assert resp.status == 200
+    assert await _wait_for(lambda: not exp.rounds.in_progress, 30.0)
+
+
+# ----------------------------------------------------------------------
+# e2e: a round aggregated through the edge tier
+
+
+def test_edge_round_e2e():
+    async def main():
+        model = linear_regression_model(10)
+        nprng = np.random.default_rng(11)
+        trainer = make_local_trainer(model, batch_size=32,
+                                     learning_rate=0.02)
+        exp, edge, workers, runners, mport, _ = await _build_tier(
+            model, trainer, nprng
+        )
+        try:
+            for _ in range(2):
+                await _drive_round(mport, "ed", exp)
+            # the edge shipped while the round was open; give its
+            # post-ship span shipping a beat before reading counters
+            await _wait_for(
+                lambda: counter(edge.metrics, "edge_partials_shipped") >= 2
+            )
+
+            m = exp.metrics.snapshot()["counters"]
+            # the root saw ONE update per round — the edge partial —
+            # but credited every cohort member inside it
+            assert m.get("updates_received_edge_partial", 0) == 2
+            assert m.get("edge_contributors_credited", 0) == 4
+            assert m.get("updates_received", 0) == 4
+            assert m.get("updates_refused_edge_secure", 0) == 0
+
+            e = edge.metrics.snapshot()["counters"]
+            assert e.get("edge_registers_proxied", 0) == 2
+            assert e.get("edge_relay_notifies", 0) == 4
+            assert e.get("edge_updates_folded", 0) == 4
+            assert e.get("edge_partials_shipped", 0) == 2
+            # downlink fan-out collapse: one root fetch per round blob,
+            # the second worker served from the edge cache
+            assert e.get("edge_blob_fetches", 0) == 2
+            assert e.get("edge_blob_hits", 0) >= 2
+            assert e.get("edge_bytes_served", 0) > 0
+            assert e.get("edge_updates_refused_secure", 0) == 0
+
+            for w in workers:
+                wc = w.metrics.snapshot()["counters"]
+                assert wc.get("edge_route_fallbacks", 0) == 0
+                assert wc.get("updates_delivered", 0) == 2
+
+            assert exp.rounds.n_rounds == 2
+            sd = params_to_state_dict(exp.params)
+            assert all(np.all(np.isfinite(np.asarray(v)))
+                       for v in sd.values())
+        finally:
+            for r in runners:
+                await r.cleanup()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# chaos: edge killed mid-round → direct-to-root fallback completes it
+
+
+def test_edge_killed_mid_round_falls_back_direct():
+    async def main():
+        model = linear_regression_model(10)
+        nprng = np.random.default_rng(13)
+        trainer = make_local_trainer(model, batch_size=32,
+                                     learning_rate=0.02)
+        gate = threading.Event()
+        gate.set()  # round 1 trains straight through
+        exp, edge, workers, runners, mport, erunner = await _build_tier(
+            model, trainer, nprng, gate=gate
+        )
+        try:
+            # round 1 proves the edge path end to end
+            await _drive_round(mport, "ed", exp)
+            assert counter(exp.metrics, "updates_received_edge_partial") == 1
+
+            # round 2: cohort notified THROUGH the edge, then the edge
+            # dies while both workers sit in local_train (held by the
+            # gate) — their uploads must land direct at the root
+            gate.clear()
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{mport}/ed/start_round?n_epoch=1"
+                ) as resp:
+                    assert resp.status == 200
+            started = await _wait_for(
+                lambda: exp.rounds.in_progress
+                and len(exp.rounds.clients) >= 2
+            )
+            assert started, "cohort never entered round 2"
+            await erunner.cleanup()  # the edge is gone, mid-round
+            gate.set()
+            assert await _wait_for(
+                lambda: not exp.rounds.in_progress, 30.0
+            ), "round 2 wedged after edge death"
+
+            m = exp.metrics.snapshot()["counters"]
+            # round 2's updates arrived as PLAIN direct uploads
+            assert m.get("updates_received", 0) == 4
+            assert m.get("updates_received_edge_partial", 0) == 1
+            assert exp.rounds.n_rounds == 2
+            assert sum(
+                counter(w.metrics, "edge_route_fallbacks")
+                for w in workers
+            ) >= 2
+            for w in workers:
+                assert counter(w.metrics, "updates_delivered") == 2
+        finally:
+            for r in runners[:-1]:  # edge runner already cleaned
+                if r is not erunner:
+                    await r.cleanup()
+            await runners[-1].cleanup()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# secure-aggregation guards, both tiers
+
+
+def test_edge_refuses_masked_upload_409():
+    """A masked body reaching the edge is a downgrade guard firing:
+    409 + counter, never a fold."""
+
+    async def main():
+        app = web.Application()
+        edge = EdgeAggregator(
+            app, "127.0.0.1:1", name="sg", port=1, edge_name="e0",
+            auto_start=False,
+        )
+        edge._workers["c1"] = _WorkerRoute(url="http://x/", key="k1")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        body = wire.encode(
+            {"w": np.zeros((4,), np.float32)},
+            {"update_name": "r1", "n_samples": 4, "update_id": "u1",
+             "secure": {"masked": True}},
+        )
+        resp = await client.post(
+            "/sg/update?client_id=c1&key=k1", data=body,
+            headers={"Content-Type": wire.CONTENT_TYPE},
+        )
+        assert resp.status == 409
+        assert counter(edge.metrics, "edge_updates_refused_secure") == 1
+        # wrong credentials never reach the refusal path
+        resp = await client.post(
+            "/sg/update?client_id=c1&key=bad", data=body
+        )
+        assert resp.status == 401
+        await client.close()
+        edge._pipe.shutdown()
+
+    asyncio.run(main())
+
+
+def test_root_refuses_edge_partial_in_secure_round():
+    """The root's half of the guard: an edge partial against a secure
+    experiment answers 409 + ``updates_refused_edge_secure`` (folding
+    a partial of ring elements would break unmasking); a buffered
+    (non-streaming) experiment answers 409 + its own counter."""
+
+    async def main():
+        app = web.Application()
+        manager = Manager(app)
+        sec = manager.register_experiment(
+            linear_regression_model(6), name="sec",
+            start_background_tasks=False, secure_agg=True,
+        )
+        buf = manager.register_experiment(
+            linear_regression_model(6), name="buf",
+            start_background_tasks=False, streaming_aggregation=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+
+        async def register(name):
+            resp = await client.get(f"/{name}/register", json={"port": 1})
+            return await resp.json()
+
+        def hand(exp, cids):
+            rn = exp.rounds.start_round(n_epoch=1)
+            exp._broadcast_anchor_sd = {
+                k: np.ascontiguousarray(np.asarray(v))
+                for k, v in params_to_state_dict(exp.params).items()
+            }
+            if exp.streaming_aggregation:
+                exp._stream_acc = exp._new_stream_acc()
+            for cid in cids:
+                exp.rounds.client_start(cid)
+            return rn
+
+        for exp, name, refusal in (
+            (sec, "sec", "updates_refused_edge_secure"),
+            (buf, "buf", "updates_refused_edge_unsupported"),
+        ):
+            ecreds = await register(name)
+            wcreds = await register(name)
+            rn = hand(exp, [wcreds["client_id"]])
+            partial = params_to_state_dict(exp.params)
+            body = wire.encode(
+                {k: np.asarray(v, np.float32) for k, v in partial.items()},
+                {
+                    "update_name": rn, "n_samples": 8.0,
+                    "loss_history": [], "update_id": "ep-1",
+                    "edge_partial": {
+                        "edge": "e0",
+                        "contributors": {
+                            wcreds["client_id"]: {
+                                "n_samples": 8.0, "update_id": "u-1",
+                                "loss_history": [0.2],
+                            }
+                        },
+                    },
+                },
+            )
+            resp = await client.post(
+                f"/{name}/update?client_id={ecreds['client_id']}"
+                f"&key={ecreds['key']}",
+                data=body, headers={"Content-Type": wire.CONTENT_TYPE},
+            )
+            assert resp.status == 409, (name, await resp.text())
+            assert counter(exp.metrics, refusal) == 1
+            assert counter(exp.metrics, "updates_received") == 0
+        await client.close()
+
+    asyncio.run(main())

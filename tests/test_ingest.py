@@ -613,6 +613,130 @@ def test_fold_shards_matches_sequential_and_buffered():
     asyncio.run(main())
 
 
+def test_edge_partial_fold_matches_flat_and_buffered():
+    """Twelve uploads folded three ways — grouped into 3 edge-aggregator
+    cohort partials, direct through the flat streaming path, and direct
+    through the buffered path — land on the same aggregate within fp32
+    tolerance. ``StreamingMean`` is associative: a cohort's
+    ``mean × Σw`` is its weighted sum, so folding the partial back with
+    the summed weight reproduces the flat fold. Also covers at-least-
+    once partial redelivery (dedup by ``(edge, update_id)``, no double
+    credit)."""
+
+    async def main():
+        app = web.Application()
+        manager = Manager(app)
+        exps = {
+            "edgp": manager.register_experiment(
+                linear_regression_model(48), name="edgp",
+                start_background_tasks=False, streaming_aggregation=True,
+            ),
+            "flat": manager.register_experiment(
+                linear_regression_model(48), name="flat",
+                start_background_tasks=False, streaming_aggregation=True,
+            ),
+            "bufd": manager.register_experiment(
+                linear_regression_model(48), name="bufd",
+                start_background_tasks=False, streaming_aggregation=False,
+            ),
+        }
+        client = TestClient(TestServer(app))
+        await client.start_server()
+
+        rng = np.random.default_rng(9)
+        template = params_to_state_dict(exps["edgp"].params)
+        uploads = [
+            (
+                {k: np.asarray(rng.normal(size=np.shape(v)), np.float32)
+                 for k, v in template.items()},
+                float(n),
+            )
+            for n in (8, 24, 3, 17, 40, 5, 12, 60, 2, 31, 9, 14)
+        ]
+        cohorts = [uploads[i::3] for i in range(3)]  # 3 edges × 4 workers
+
+        # flat + buffered reference folds: 12 direct uploads each
+        for label in ("flat", "bufd"):
+            exp = exps[label]
+            creds = [
+                await _register(client, label, port=i + 1)
+                for i in range(len(uploads))
+            ]
+            round_name = _hand_round(exp, [c["client_id"] for c in creds])
+            for (sd, n), c in zip(uploads, creds):
+                body = wire.encode(sd, {
+                    "update_name": round_name, "n_samples": n,
+                    "loss_history": [0.1],
+                    "update_id": f"u-{c['client_id']}",
+                })
+                resp = await client.post(
+                    f"/{label}/update?client_id={c['client_id']}"
+                    f"&key={c['key']}",
+                    data=body, headers={"Content-Type": wire.CONTENT_TYPE},
+                )
+                assert resp.status == 200
+
+        # edge-tier fold: each cohort collapses to ONE partial upload
+        exp = exps["edgp"]
+        wcreds = [
+            await _register(client, "edgp", port=i + 1)
+            for i in range(len(uploads))
+        ]
+        ecreds = [
+            await _register(client, "edgp", port=100 + i) for i in range(3)
+        ]  # the edges register too but are never round participants
+        round_name = _hand_round(exp, [c["client_id"] for c in wcreds])
+        wcreds_by_cohort = [wcreds[i::3] for i in range(3)]
+        for e, (cohort, members, ec) in enumerate(
+            zip(cohorts, wcreds_by_cohort, ecreds)
+        ):
+            acc = agg.StreamingMean()
+            contributors = {}
+            for (sd, n), c in zip(cohort, members):
+                acc.add(sd, n)
+                contributors[c["client_id"]] = {
+                    "n_samples": n, "update_id": f"u-{c['client_id']}",
+                    "loss_history": [0.1],
+                }
+            body = wire.encode(acc.mean(), {
+                "update_name": round_name,
+                "n_samples": acc.total_weight,
+                "loss_history": [],
+                "update_id": f"ep-{e}",
+                "edge_partial": {
+                    "edge": f"e{e}", "contributors": contributors,
+                },
+            })
+            for attempt in range(2 if e == 0 else 1):  # redeliver #0
+                resp = await client.post(
+                    f"/edgp/update?client_id={ec['client_id']}"
+                    f"&key={ec['key']}",
+                    data=body, headers={"Content-Type": wire.CONTENT_TYPE},
+                )
+                assert resp.status == 200, await resp.text()
+
+        m = exp.metrics.snapshot()["counters"]
+        assert m.get("updates_received_edge_partial", 0) == 3
+        assert m.get("updates_received", 0) == 12
+        assert m.get("edge_contributors_credited", 0) == 12
+        assert m.get("duplicate_updates_deduped", 0) == 1
+        assert m.get("edge_contributor_conflicts", 0) == 0
+        assert m.get("edge_contributors_unknown", 0) == 0
+        assert not exp.rounds.in_progress  # all 12 credited → finished
+
+        sd_ref = params_to_state_dict(exps["bufd"].params)
+        for label in ("edgp", "flat"):
+            got = params_to_state_dict(exps[label].params)
+            for k in sd_ref:
+                np.testing.assert_allclose(
+                    np.asarray(got[k]), np.asarray(sd_ref[k]),
+                    rtol=1e-5, atol=1e-6,
+                )
+        await client.close()
+
+    asyncio.run(main())
+
+
 # ----------------------------------------------------------------------
 # narrowed error handling
 
