@@ -108,8 +108,9 @@ def _normalize_registry(reg) -> Optional[dict]:
     Accepts the legacy 2-tuple ``(declared_counters, counter_prefixes)``
     (timer/gauge audit disabled — pre-existing fixtures keep passing)
     or the full dict shape with ``counters`` / ``counter_prefixes`` /
-    ``timers`` / ``gauges`` keys, where ``timers``/``gauges`` may be
-    None to disable that audit."""
+    ``timers`` / ``gauges`` / ``exemplar_timers`` keys, where
+    ``timers``/``gauges``/``exemplar_timers`` may be None to disable
+    that audit."""
     if reg is None:
         return None
     if isinstance(reg, dict):
@@ -124,6 +125,10 @@ def _normalize_registry(reg) -> Optional[dict]:
                 frozenset(reg["gauges"])
                 if reg.get("gauges") is not None else None
             ),
+            "exemplar_timers": (
+                frozenset(reg["exemplar_timers"])
+                if reg.get("exemplar_timers") is not None else None
+            ),
         }
     names, prefixes = reg
     return {
@@ -131,6 +136,7 @@ def _normalize_registry(reg) -> Optional[dict]:
         "counter_prefixes": tuple(prefixes),
         "timers": None,
         "gauges": None,
+        "exemplar_timers": None,
     }
 
 
@@ -391,7 +397,8 @@ def _resolve_counter_registry(
     Walks the file's ancestors for a ``baton_tpu/utils/metrics.py``
     (covering both in-repo paths and fixture trees) and parses its
     ``DECLARED_COUNTERS`` / ``DECLARED_COUNTER_PREFIXES`` /
-    ``DECLARED_TIMERS`` / ``DECLARED_GAUGES`` literals with
+    ``DECLARED_TIMERS`` / ``DECLARED_GAUGES`` /
+    ``DECLARED_EXEMPLAR_TIMERS`` literals with
     ``ast.literal_eval`` — no import, so linting never executes package
     code. ``None`` (registry not found) disables BTL030 for the file;
     a registry without timer/gauge sets disables just those audits.
@@ -426,6 +433,7 @@ def _parse_counter_registry(
     prefixes: tuple = ()
     timers: Optional[frozenset] = None
     gauges: Optional[frozenset] = None
+    exemplar_timers: Optional[frozenset] = None
     for node in tree.body:
         if not isinstance(node, ast.Assign) or len(node.targets) != 1:
             continue
@@ -453,6 +461,8 @@ def _parse_counter_registry(
             timers = frozenset(str(x) for x in literal)
         elif target.id == "DECLARED_GAUGES":
             gauges = frozenset(str(x) for x in literal)
+        elif target.id == "DECLARED_EXEMPLAR_TIMERS":
+            exemplar_timers = frozenset(str(x) for x in literal)
     if names is None:
         return None
     return {
@@ -460,6 +470,7 @@ def _parse_counter_registry(
         "counter_prefixes": prefixes,
         "timers": timers,
         "gauges": gauges,
+        "exemplar_timers": exemplar_timers,
     }
 
 

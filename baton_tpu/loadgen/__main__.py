@@ -74,12 +74,18 @@ def main(argv=None) -> int:
     if os.path.exists(edge_metrics_path):
         with open(edge_metrics_path, encoding="utf-8") as fh:
             edge_snapshot = json.load(fh)
+    history = None
+    history_path = os.path.join(artifacts, "metrics_history.json")
+    if os.path.exists(history_path):
+        with open(history_path, encoding="utf-8") as fh:
+            history = json.load(fh).get("history")
     try:
         report = evaluate_slo(
             scenario.slo, records, snapshot,
             loadgen_snapshot=loadgen_snapshot,
             fleet_snapshot=fleet_snapshot,
             edge_snapshot=edge_snapshot,
+            history=history,
             n_torn=n_torn,
             exclude_rounds=summary["warmup_round_names"],
             scenario_name=scenario.name,

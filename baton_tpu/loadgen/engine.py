@@ -77,6 +77,30 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+class _TeeMetrics(Metrics):
+    """Per-worker registry that mirrors every write into the shared
+    fleet registry. The evaluator's ``fleet:*`` namespace keeps its
+    aggregate semantics (one histogram across the whole simulated
+    fleet) while each worker's own copy makes per-worker attribution —
+    *which* device's heartbeat went bad — possible after the run."""
+
+    def __init__(self, shared: Metrics) -> None:
+        super().__init__()
+        self._shared = shared
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        super().inc(name, value)
+        self._shared.inc(name, value)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        super().set_gauge(name, value)
+        self._shared.set_gauge(name, value)
+
+    def observe(self, name, seconds, exemplar=None) -> None:
+        super().observe(name, seconds, exemplar=exemplar)
+        self._shared.observe(name, seconds, exemplar=exemplar)
+
+
 class _WorkerSlot:
     """One simulated device: its worker, server runner, fault injector
     (availability gate + phase faults), and the flags the ticker flips."""
@@ -218,7 +242,7 @@ class ScenarioRunner:
             edge=self._edge_for(idx),
             edge_retry_s=scn.edges.retry_s,
         )
-        worker.metrics = self.fleet_metrics
+        worker.metrics = _TeeMetrics(self.fleet_metrics)
         runner = web.AppRunner(wapp)
         await runner.setup()
         await web.TCPSite(runner, "127.0.0.1", worker.port).start()
@@ -480,6 +504,27 @@ class ScenarioRunner:
             f"http://127.0.0.1:{self._mport}/{scn.name}/metrics"
         ) as resp:
             manager_metrics = await resp.json()
+        # the manager's timestamped snapshot ring: the SLO evaluator's
+        # ``history:*`` rate/delta namespace derives from this
+        metrics_history = None
+        try:
+            async with self._session.get(
+                f"http://127.0.0.1:{self._mport}/{scn.name}"
+                "/metrics/history"
+            ) as resp:
+                if resp.status == 200:
+                    metrics_history = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            pass
+        fleet_health = None
+        try:
+            async with self._session.get(
+                f"http://127.0.0.1:{self._mport}/{scn.name}/fleet/health"
+            ) as resp:
+                if resp.status == 200:
+                    fleet_health = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            pass
         loadgen_metrics = self.metrics.snapshot()
         worker_metrics = self.fleet_metrics.snapshot()
         edge_metrics = self.edge_metrics.snapshot()
@@ -501,9 +546,19 @@ class ScenarioRunner:
         }
         self._write_json("manager_metrics.json", manager_metrics)
         self._write_json("worker_metrics.json", worker_metrics)
+        # per-worker attribution rides in a sibling artifact so the
+        # aggregate fleet:* addresses keep their exact semantics
+        self._write_json("worker_metrics_per_worker.json", {
+            f"w{slot.idx}": slot.worker.metrics.snapshot()
+            for slot in self._slots
+        })
         if scn.edges.count > 0:
             self._write_json("edge_metrics.json", edge_metrics)
         self._write_json("loadgen_metrics.json", loadgen_metrics)
+        if metrics_history is not None:
+            self._write_json("metrics_history.json", metrics_history)
+        if fleet_health is not None:
+            self._write_json("fleet_health.json", fleet_health)
         self._write_json("scenario_summary.json", summary)
         return summary
 

@@ -26,6 +26,13 @@ flat ``{metric_name: float}`` namespace:
 ``loadgen:<name>``
     The scenario driver's own counters/gauges (423 refusals, churn
     events, forced round ends).
+``history:samples`` / ``history:span_s`` / ``history:delta:<counter>``
+    / ``history:rate:<counter>``
+    Derived from the manager's ``/metrics/history`` snapshot ring
+    (``metrics_history.json``): windowed counter deltas over the run
+    and per-second rates over the ring's wall-clock span. These are
+    NOT absence-is-zero — a run that produced no history ring (or too
+    few samples for a rate) fails the assertion, same rule as timers.
 
 A *counter* address that the run never touched resolves to 0 — a
 counter is born at its first ``inc``, so absence IS zero
@@ -191,6 +198,43 @@ def derive_metrics(
     return m
 
 
+def derive_history_metrics(history: Optional[List[dict]]) -> Dict[str, float]:
+    """``history:*`` metrics from a ``/metrics/history`` snapshot ring.
+
+    ``history:delta:<counter>`` is last-minus-first over the ring;
+    ``history:rate:<counter>`` divides that by the ring's wall-clock
+    span. With fewer than two timestamped snapshots only
+    ``history:samples`` exists — an asserted rate then resolves missing
+    and fails, which is the point: "we stopped recording history" must
+    not pass a rate SLO vacuously."""
+    m: Dict[str, float] = {}
+    snaps = sorted(
+        (
+            s for s in (history or [])
+            if isinstance(s, dict)
+            and isinstance(s.get("ts"), (int, float))
+        ),
+        key=lambda s: s["ts"],
+    )
+    m["history:samples"] = float(len(snaps))
+    if len(snaps) < 2:
+        return m
+    first, last = snaps[0], snaps[-1]
+    span = float(last["ts"]) - float(first["ts"])
+    m["history:span_s"] = span
+    c0 = first.get("counters") or {}
+    c1 = last.get("counters") or {}
+    for name in set(c0) | set(c1):
+        try:
+            delta = float(c1.get(name, 0.0)) - float(c0.get(name, 0.0))
+        except (TypeError, ValueError):
+            continue
+        m[f"history:delta:{name}"] = delta
+        if span > 0:
+            m[f"history:rate:{name}"] = delta / span
+    return m
+
+
 def _compare(observed: float, op: str, value: float) -> bool:
     if op == "<=":
         return observed <= value
@@ -346,6 +390,7 @@ def evaluate_slo(
     loadgen_snapshot: Optional[dict] = None,
     fleet_snapshot: Optional[dict] = None,
     edge_snapshot: Optional[dict] = None,
+    history: Optional[List[dict]] = None,
     baseline: Optional[dict] = None,
     n_torn: int = 0,
     exclude_rounds: Iterable[str] = (),
@@ -362,6 +407,8 @@ def evaluate_slo(
     kept = [r for r in records if r.get("round") not in excluded]
     metrics = derive_metrics(kept, snapshot, loadgen_snapshot,
                              fleet_snapshot, edge_snapshot)
+    if history is not None:
+        metrics.update(derive_history_metrics(history))
     assertions = check_assertions(slo.assertions, metrics)
 
     baseline_block = None

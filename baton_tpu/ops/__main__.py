@@ -1,0 +1,12 @@
+"""``python -m baton_tpu.ops`` — the live fleet ops console.
+
+Thin entry point; everything lives in :mod:`baton_tpu.ops.console` so
+the poll/render helpers are importable (and testable) without argv.
+"""
+
+import sys
+
+from baton_tpu.ops.console import main
+
+if __name__ == "__main__":
+    sys.exit(main())

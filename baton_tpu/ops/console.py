@@ -1,0 +1,252 @@
+"""Live fleet ops console: ``python -m baton_tpu.ops``.
+
+Polls the root manager and any number of edges over plain HTTP —
+``GET …/metrics``, ``GET …/fleet/health`` — plus (optionally) the
+manager's ``rounds.jsonl``, and renders a top-like terminal view:
+round throughput, per-tier phase counters, and every known client with
+its fleet-health classification (healthy / slow / flaky / degrading /
+inactive) and the reason string the anomaly scorer produced.
+
+Two modes:
+
+- **live** (default): clear-screen redraw every ``--interval`` seconds
+  until interrupted — the operator's ``top`` for a federation.
+- **``--once --json``**: one poll, machine-readable JSON on stdout,
+  exit 0 if every polled node answered and 1 otherwise — usable as a
+  CI smoke probe (``scripts/smoke_trace.py`` runs exactly this).
+
+stdlib-only on purpose (``urllib``, no aiohttp, no asyncio): the
+console must work from any operator shell that can ``python -m``, even
+one without the serving stack's event-loop context.
+
+URLs name the experiment base, e.g. ``http://127.0.0.1:8473/fedmodel``
+— the console appends ``/metrics`` and ``/fleet/health`` itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+__all__ = ["fetch_json", "poll_node", "poll_fleet", "render", "main"]
+
+#: severity order for the client table (worst first)
+_STATUS_ORDER = {"slow": 0, "flaky": 1, "degrading": 2, "healthy": 3,
+                 "inactive": 4}
+_STATUS_COLOR = {"slow": "\x1b[31m", "flaky": "\x1b[35m",
+                 "degrading": "\x1b[33m", "healthy": "\x1b[32m",
+                 "inactive": "\x1b[2m"}
+_RESET = "\x1b[0m"
+
+
+def fetch_json(url: str, timeout_s: float = 3.0) -> Optional[dict]:
+    """GET one JSON document; None on any transport/decode failure —
+    a dead node is a *row* in the console, never a crash."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def poll_node(base_url: str, timeout_s: float = 3.0) -> dict:
+    """One node's ``/metrics`` + ``/fleet/health``, tagged with
+    reachability (``up``) so the renderer can show dead tiers."""
+    base = base_url.rstrip("/")
+    metrics = fetch_json(f"{base}/metrics", timeout_s)
+    health = fetch_json(f"{base}/fleet/health", timeout_s)
+    return {
+        "url": base,
+        "up": metrics is not None,
+        "metrics": metrics,
+        "health": health,
+    }
+
+
+def _tail_rounds(path: Optional[str], n: int = 5) -> List[dict]:
+    if not path:
+        return []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.readlines()
+    except OSError:
+        return []
+    out: List[dict] = []
+    for line in lines[-n:]:
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue  # torn final line from a crash mid-append
+    return out
+
+
+def poll_fleet(
+    root: str,
+    edges: List[str],
+    rounds_path: Optional[str] = None,
+    timeout_s: float = 3.0,
+) -> dict:
+    """The full console state for one poll — also the ``--json``
+    payload, so the interactive view and the CI probe can never
+    drift apart."""
+    return {
+        "ts": round(time.time(), 3),
+        "root": poll_node(root, timeout_s),
+        "edges": [poll_node(e, timeout_s) for e in edges],
+        "rounds_tail": _tail_rounds(rounds_path),
+    }
+
+
+# -- rendering ---------------------------------------------------------
+def _fmt_s(v: Any) -> str:
+    if isinstance(v, (int, float)):
+        return f"{v:8.3f}s"
+    return "       --"
+
+
+def _counter(node: dict, name: str) -> float:
+    m = node.get("metrics") or {}
+    return float((m.get("counters") or {}).get(name, 0.0))
+
+
+def _client_rows(health: Optional[dict], via: str) -> List[tuple]:
+    rows = []
+    for cid, info in ((health or {}).get("clients") or {}).items():
+        rows.append((
+            _STATUS_ORDER.get(info.get("status"), 9), cid, via, info
+        ))
+    return rows
+
+
+def render(state: dict, color: bool = True) -> str:
+    """One frame of the top-like view as a string (the caller owns the
+    clear-screen escape so tests can snapshot frames)."""
+
+    def paint(status: str, text: str) -> str:
+        if not color:
+            return text
+        return f"{_STATUS_COLOR.get(status, '')}{text}{_RESET}"
+
+    root = state["root"]
+    lines: List[str] = []
+    stamp = time.strftime("%H:%M:%S", time.localtime(state["ts"]))
+    up = "up" if root["up"] else paint("slow", "DOWN")
+    lines.append(
+        f"baton fleet console  {stamp}  root={root['url']} [{up}]  "
+        f"edges={sum(1 for e in state['edges'] if e['up'])}"
+        f"/{len(state['edges'])} up"
+    )
+    lines.append(
+        f"  rounds finished={_counter(root, 'rounds_finished'):.0f}  "
+        f"updates={_counter(root, 'updates_received'):.0f}  "
+        f"edge partials={_counter(root, 'updates_received_edge_partial'):.0f}  "
+        f"fleet obs={_counter(root, 'fleet_observations'):.0f}"
+    )
+    for e in state["edges"]:
+        phases = "  ".join(
+            f"{k.split('edge_phase_')[-1]}={_counter(e, k):.2f}s"
+            for k in ("edge_phase_fold_s", "edge_phase_settle_s")
+        ) if e["up"] else "unreachable"
+        node = ((e.get("health") or {}).get("node")) or e["url"]
+        mark = "" if e["up"] else " [DOWN]"
+        lines.append(f"  {node}{mark}: "
+                     f"folded={_counter(e, 'edge_updates_folded'):.0f}  "
+                     f"shipped={_counter(e, 'edge_partials_shipped'):.0f}  "
+                     f"{phases}")
+
+    summary = ((root.get("health") or {}).get("summary")) or {}
+    if summary:
+        lines.append(
+            "  health: " + "  ".join(
+                paint(k, f"{k}={summary.get(k, 0)}")
+                for k in ("healthy", "slow", "flaky", "degrading",
+                          "inactive")
+            ) + f"  total={summary.get('total', 0)}"
+        )
+    lines.append("")
+    lines.append(f"  {'CLIENT':<28} {'VIA':<10} {'STATUS':<10} "
+                 f"{'TRAIN':>9} {'ROUNDS':>6} {'MISS':>4}  REASON")
+    rows = _client_rows(root.get("health"), "root")
+    for e in state["edges"]:
+        rows += _client_rows(e.get("health"),
+                             ((e.get("health") or {}).get("node")) or "edge")
+    rows.sort(key=lambda r: (r[0], r[1]))
+    for _, cid, via, info in rows:
+        status = info.get("status", "?")
+        lines.append(
+            f"  {cid:<28.28} {via:<10.10} "
+            + paint(status, f"{status:<10}")
+            + f" {_fmt_s(info.get('train_s_median'))}"
+            f" {info.get('rounds_seen', 0):>6}"
+            f" {info.get('missed', 0):>4}"
+            f"  {info.get('reason', '')}"
+        )
+    tail = state.get("rounds_tail") or []
+    if tail:
+        lines.append("")
+        lines.append("  recent rounds:")
+        for r in tail:
+            why = r.get("straggler_why") or {}
+            why_s = ("  why: " + "; ".join(
+                f"{c}: {w}" for c, w in sorted(why.items())
+            )) if why else ""
+            lines.append(
+                f"    {r.get('round')}: {r.get('outcome')} "
+                f"{float(r.get('duration_s') or 0.0):.2f}s "
+                f"reporters={r.get('reporters')}"
+                f"/{r.get('participants')}{why_s}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m baton_tpu.ops",
+        description="live fleet health console (root + edges)",
+    )
+    ap.add_argument("--root", required=True,
+                    help="experiment base URL, e.g. "
+                         "http://127.0.0.1:8473/fedmodel")
+    ap.add_argument("--edges", default="",
+                    help="comma-separated edge base URLs")
+    ap.add_argument("--rounds", default=None,
+                    help="path to the manager's rounds.jsonl (optional; "
+                         "adds the recent-rounds pane)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval in live mode (default 2s)")
+    ap.add_argument("--timeout", type=float, default=3.0,
+                    help="per-request HTTP timeout")
+    ap.add_argument("--once", action="store_true",
+                    help="poll once and exit (exit 1 if a node is down)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the raw poll state as JSON (implies no "
+                         "ANSI); with --once this is the CI probe mode")
+    args = ap.parse_args(argv)
+
+    edges = [e.strip() for e in args.edges.split(",") if e.strip()]
+    while True:
+        state = poll_fleet(args.root, edges, args.rounds, args.timeout)
+        all_up = state["root"]["up"] and all(
+            e["up"] for e in state["edges"]
+        )
+        if args.as_json:
+            print(json.dumps(state, indent=2, default=repr))
+        else:
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(render(state, color=sys.stdout.isatty()))
+        if args.once:
+            return 0 if all_up else 1
+        try:
+            time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

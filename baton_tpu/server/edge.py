@@ -47,8 +47,10 @@ import asyncio
 import dataclasses
 import hashlib
 import json
+import logging
 import random
 import re
+import time
 from typing import Dict, Optional, Set, Tuple
 
 import aiohttp
@@ -58,10 +60,12 @@ import numpy as np
 from baton_tpu.ops.aggregation import StreamingMean
 from baton_tpu.server import wire
 from baton_tpu.server.blobs import BlobStore
+from baton_tpu.server.fleet import ClientLedger
 from baton_tpu.server.ingest import IngestPipeline
 from baton_tpu.server.utils import (
     BodyTooLarge,
     PeriodicTask,
+    json_clean,
     random_key,
     read_body_capped,
     read_json_capped,
@@ -138,6 +142,11 @@ class _EdgeRound:
         self.ship_update_id = random_key(16)
         self.settle_task: Optional[asyncio.Future] = None
         self.deadline_task: Optional[asyncio.Future] = None
+        # per-round phase wall times shipped upstream in the partial's
+        # meta (the root folds them into the round's counter deltas)
+        self.t0 = time.monotonic()
+        self.fold_s = 0.0
+        self.fetch_s = 0.0
 
     def cancel_tasks(self) -> None:
         for t in (self.settle_task, self.deadline_task):
@@ -172,6 +181,9 @@ class EdgeAggregator:
         upload_chunk_bytes: Optional[int] = None,
         max_upload_bytes: Optional[int] = 1 << 30,
         metrics: Optional[Metrics] = None,
+        clients_log_path: Optional[str] = None,
+        health_window: int = 32,
+        metrics_history_interval_s: float = 5.0,
         auto_start: bool = True,
     ) -> None:
         self.name = name
@@ -187,6 +199,16 @@ class EdgeAggregator:
 
         self.metrics = metrics if metrics is not None else Metrics()
         self.tracer = Tracer(service=f"edge:{self.edge_name}")
+        # this tier's half of the fleet health plane: the edge ledgers
+        # its own cohort (the workers it relays for), the root ledgers
+        # everyone — same scoring, different vantage point
+        self.fleet = ClientLedger(
+            window=health_window, log_path=clients_log_path,
+            metrics=self.metrics, node=f"edge:{self.edge_name}",
+        )
+        self.metrics_history_interval_s = float(metrics_history_interval_s)
+        self._history_task: Optional[PeriodicTask] = None
+        self._last_ship_s: Optional[float] = None
         self._pipe = IngestPipeline(
             workers=ingest_workers, queue_depth=ingest_queue_depth,
             fold_shards=1, metrics=self.metrics, tracer=self.tracer,
@@ -229,6 +251,10 @@ class EdgeAggregator:
         r.add_post(f"/{self.name}/relay/{{tail}}", self.handle_relay)
         r.add_post(f"/{self.name}/edge/{{tail}}", self.handle_edge_callback)
         r.add_get(f"/{self.name}/metrics", self.handle_metrics)
+        r.add_get(
+            f"/{self.name}/metrics/history", self.handle_metrics_history
+        )
+        r.add_get(f"/{self.name}/fleet/health", self.handle_fleet_health)
         if auto_start:
             app.on_startup.append(self._on_startup)
             app.on_cleanup.append(self._on_cleanup)
@@ -239,11 +265,21 @@ class EdgeAggregator:
         self._heartbeat_task = PeriodicTask(
             self._heartbeat_tick, self.heartbeat_time
         ).start()
+        if self.metrics_history_interval_s > 0:
+            self._history_task = PeriodicTask(
+                self._history_tick, self.metrics_history_interval_s
+            ).start()
+
+    async def _history_tick(self) -> None:
+        self.fleet.export_gauges(self.metrics)
+        self.metrics.record_history()
 
     async def _on_cleanup(self, app=None) -> None:
         self._closed = True
         if self._heartbeat_task is not None:
             await self._heartbeat_task.stop()
+        if self._history_task is not None:
+            await self._history_task.stop()
         r = self._round
         if r is not None:
             r.cancel_tasks()
@@ -633,6 +669,7 @@ class EdgeAggregator:
                 # the root rolled the round under our feet (watchdog
                 # force-end, abort): the partial can never land
                 self.metrics.inc("edge_partials_abandoned")
+            self._ledger_round(r)
         secure = env.get("secure") is not None
         encoded = bool(env.get("encoding"))
         r = _EdgeRound(
@@ -666,7 +703,9 @@ class EdgeAggregator:
         template the fold path checks shapes against. A failed prefetch
         degrades the round to proxy-only — never blocks it."""
         try:
+            t_fetch0 = time.monotonic()
             data = await self._ensure_blob(r.digest, r.size)
+            r.fetch_s = time.monotonic() - t_fetch0
             if data is not None:
                 r.template = (await asyncio.to_thread(wire.decode, data))[0]
             else:
@@ -681,6 +720,31 @@ class EdgeAggregator:
             "edge_round_pending",
             max(0, len(r.notified - set(r.contributors))),
         )
+
+    def _ledger_round(self, r: _EdgeRound) -> None:
+        """Fold one retired round into this edge's cohort ledger:
+        contributors reported (with their self-reported timings and
+        body size), notified-but-silent workers straggled. Best-effort
+        — health accounting must never break a round roll."""
+        if not r.notified and not r.contributors:
+            return
+        try:
+            responses = {
+                cid: {
+                    "n_samples": c.get("n_samples"),
+                    "loss_history": c.get("loss_history"),
+                    "upload_bytes": c.get("bytes"),
+                    "timings": c.get("timings"),
+                }
+                for cid, c in r.contributors.items()
+            }
+            self.fleet.record_round(
+                r.round_name, r.notified, r.notified, responses
+            )
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "edge fleet ledger record failed"
+            )
 
     # -- uplink: cohort ingest + fold ----------------------------------
     async def handle_update(self, request: web.Request) -> web.Response:
@@ -801,21 +865,31 @@ class EdgeAggregator:
         # drains our fold before computing the partial mean
         if update_id is not None:
             r.update_ids.add(update_id)
-        r.contributors[client_id] = {
+        entry = {
             "n_samples": n_samples,
             "update_id": update_id,
             "loss_history": losses,
+            "bytes": len(body),
         }
+        timings = meta.get("timings")
+        if isinstance(timings, dict):
+            # worker self-reported wall times, shipped upstream in the
+            # partial's contributor set (the root sanitizes values)
+            entry["timings"] = timings
+        r.contributors[client_id] = entry
         r.pending_folds += 1
         self.metrics.inc("edge_updates_folded")
         self._set_pending_gauge(r)
         template = r.template
 
         def fold():
+            t_fold0 = time.perf_counter()
             payload = {
                 k: np.asarray(tensors[k], np.float32) for k in template
             }
             r.acc.add(payload, n_samples)
+            # fold_shards=1: one fold worker, so += never races
+            r.fold_s += time.perf_counter() - t_fold0
 
         try:
             await self._pipe.submit_fold(0, fold)
@@ -1007,6 +1081,17 @@ class EdgeAggregator:
             if mean is None:
                 r.shipped = True
                 return
+            # per-round phase wall times for the root's SLO counter
+            # deltas. "settle" is envelope→ship-start (fold + wait);
+            # "ship_prev" is the PREVIOUS round's measured upstream
+            # delivery — this round's isn't known until after encode.
+            phase_s = {
+                "fold": round(r.fold_s, 6),
+                "blob_fetch": round(r.fetch_s, 6),
+                "settle": round(time.monotonic() - r.t0, 6),
+            }
+            if self._last_ship_s is not None:
+                phase_s["ship_prev"] = round(self._last_ship_s, 6)
             meta = {
                 "update_name": r.round_name,
                 "n_samples": float(r.acc.total_weight),
@@ -1015,6 +1100,7 @@ class EdgeAggregator:
                 "edge_partial": {
                     "edge": self.edge_name,
                     "contributors": r.contributors,
+                    "phase_s": phase_s,
                 },
             }
             body = await asyncio.to_thread(wire.encode, mean, meta)
@@ -1025,7 +1111,9 @@ class EdgeAggregator:
                 round=r.round_name, contributors=len(r.contributors),
                 bytes=len(body),
             ) as sp, self.metrics.timer("edge_partial_ship_s"):
+                t_ship0 = time.monotonic()
                 status = await self._deliver_upstream(body, r.ship_update_id)
+                self._last_ship_s = time.monotonic() - t_ship0
                 sp.set(status=status)
             r.shipped = True
             if status == 200:
@@ -1198,6 +1286,7 @@ class EdgeAggregator:
 
     # -- observability -------------------------------------------------
     async def handle_metrics(self, request: web.Request) -> web.Response:
+        self.fleet.export_gauges(self.metrics)
         snap = self.metrics.snapshot()
         snap["edge"] = {
             "edge_name": self.edge_name,
@@ -1207,3 +1296,18 @@ class EdgeAggregator:
             "cache_bytes": self.blob_cache.total_bytes,
         }
         return web.json_response(snap)
+
+    async def handle_metrics_history(
+        self, request: web.Request
+    ) -> web.Response:
+        hist = self.metrics.history()
+        return web.json_response({
+            "interval_s": self.metrics_history_interval_s,
+            "samples": len(hist),
+            "history": hist,
+        })
+
+    async def handle_fleet_health(
+        self, request: web.Request
+    ) -> web.Response:
+        return web.json_response(json_clean(self.fleet.health_snapshot()))

@@ -93,6 +93,7 @@ from baton_tpu.core.model import FedModel
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.server import wire
 from baton_tpu.server.blobs import BlobStore
+from baton_tpu.server.fleet import ClientLedger
 from baton_tpu.server.ingest import ChunkSession, IngestPipeline
 from baton_tpu.server.registry import AuthError, ClientRegistry, UnknownClient
 from baton_tpu.server.rounds import RoundInProgress, RoundManager
@@ -113,6 +114,29 @@ from baton_tpu.utils.tracing import trace_headers
 DEFAULT_N_EPOCH = 32  # reference manager.py:52-55
 
 _log = logging.getLogger(__name__)
+
+#: worker self-reported timing fields accepted off the wire (anything
+#: else in an update's ``meta["timings"]`` is dropped at the door)
+_TIMING_KEYS = ("train_s", "upload_s", "hb_rtt_s")
+
+
+def _clean_timings(raw: Any) -> Optional[dict]:
+    """Sanitize a worker/edge-supplied ``timings`` dict: known keys
+    only, finite non-negative floats, or ``None`` when nothing valid
+    survives — ledger observations never carry attacker-shaped data."""
+    if not isinstance(raw, dict):
+        return None
+    out = {}
+    for key in _TIMING_KEYS:
+        val = raw.get(key)
+        if (
+            isinstance(val, (int, float))
+            and not isinstance(val, bool)
+            and math.isfinite(val)
+            and val >= 0
+        ):
+            out[key] = float(val)
+    return out or None
 
 
 class _BadUpload(ValueError):
@@ -185,6 +209,9 @@ class Experiment:
         max_chunk_sessions: int = 64,
         trace_dir: Optional[str] = None,
         rounds_log_path: Optional[str] = None,
+        clients_log_path: Optional[str] = None,
+        health_window: int = 32,
+        metrics_history_interval_s: float = 5.0,
     ):
         """``aggregator``: ``"mean"`` (sample-weighted FedAvg, reference
         manager.py:119-126), or Byzantine-robust ``"trimmed:<ratio>"`` /
@@ -291,7 +318,18 @@ class Experiment:
         finished/aborted round (participants, stragglers, per-round
         counter deltas, phase durations) to this JSONL file — the data
         contract the scenario harness consumes
-        (baton_tpu/utils/slog.py::RoundsLog)."""
+        (baton_tpu/utils/slog.py::RoundsLog).
+
+        ``clients_log_path``: persist the fleet health ledger's
+        per-client per-round observations to this JSONL file
+        (``clients.jsonl``, same crash-safe append discipline as
+        ``rounds.jsonl``). The in-memory ledger + classifications
+        (``GET /{name}/fleet/health``) are always on; ``health_window``
+        bounds each client's observation ring.
+
+        ``metrics_history_interval_s``: period of the background task
+        that snapshots the metrics registry into the bounded history
+        ring behind ``GET /{name}/metrics/history`` (0 disables it)."""
         if secure_agg and allow_pickle:
             raise ValueError(
                 "secure_agg is incompatible with allow_pickle: reference-"
@@ -414,6 +452,18 @@ class Experiment:
         self.rounds_log = (
             RoundsLog(rounds_log_path) if rounds_log_path else None
         )
+        # fleet health plane: per-client observation ledger + advisory
+        # anomaly classification (server/fleet.py)
+        self.fleet = ClientLedger(
+            window=health_window,
+            log_path=clients_log_path,
+            metrics=self.metrics,
+            node="manager",
+        )
+        self.metrics_history_interval_s = float(metrics_history_interval_s)
+        # the notify fan-out of the round in flight (participation
+        # denominator for the ledger's missed-round accounting)
+        self._round_cohort: list = []
         self._loop_probe = LoopLagProbe(self.metrics)
         # counter snapshot at round start — rounds.jsonl records deltas
         self._slo_base: Optional[dict] = None
@@ -632,6 +682,11 @@ class Experiment:
         self._loop_probe.start()
         cull = PeriodicTask(self._cull_tick, max(self.registry.client_ttl / 2, 1))
         self._background = [cull.start()]
+        if self.metrics_history_interval_s > 0:
+            history = PeriodicTask(
+                self._history_tick, self.metrics_history_interval_s
+            )
+            self._background.append(history.start())
         if self.rounds.round_timeout is not None:
             watchdog = PeriodicTask(
                 self._watchdog_tick, max(self.rounds.round_timeout / 4, 0.25)
@@ -669,6 +724,12 @@ class Experiment:
             self.rounds.drop_client(cid)
             self.metrics.inc("clients_culled")
         self._maybe_finish()
+
+    async def _history_tick(self) -> None:
+        # record the DERIVED snapshot (registry/round/fleet gauges
+        # included) so a history entry equals what /metrics would have
+        # answered at that instant
+        self.metrics.record_history(snapshot=self.metrics_snapshot())
 
     async def _watchdog_tick(self) -> None:
         if self._broadcasting:
@@ -709,6 +770,10 @@ class Experiment:
             self.handle_update_chunk_probe,
         )
         r.add_get(f"/{self.name}/metrics", self.handle_metrics)
+        r.add_get(
+            f"/{self.name}/metrics/history", self.handle_metrics_history
+        )
+        r.add_get(f"/{self.name}/fleet/health", self.handle_fleet_health)
         r.add_get(
             f"/{self.name}/round_blob/{{digest}}", self.handle_round_blob
         )
@@ -838,6 +903,10 @@ class Experiment:
         scraped view and the gated view cannot drift."""
         from baton_tpu.server import secure
 
+        # advisory fleet classification gauges (fleet_clients_*) are
+        # published into the registry so scrapes AND history entries
+        # carry them
+        self.fleet.export_gauges(self.metrics)
         snap = self.metrics.snapshot()
         snap["gauges"]["clients_registered"] = float(len(self.registry))
         snap["gauges"]["rounds_completed"] = float(self.rounds.n_rounds)
@@ -850,6 +919,25 @@ class Experiment:
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.json_response(self.metrics_snapshot())
+
+    async def handle_metrics_history(
+        self, request: web.Request
+    ) -> web.Response:
+        """``GET /{name}/metrics/history`` — the timestamped snapshot
+        ring (oldest first) recorded by the background history task."""
+        history = self.metrics.history()
+        return web.json_response({
+            "interval_s": self.metrics_history_interval_s,
+            "samples": len(history),
+            "history": history,
+        })
+
+    async def handle_fleet_health(
+        self, request: web.Request
+    ) -> web.Response:
+        """``GET /{name}/fleet/health`` — per-client telemetry windows
+        + advisory anomaly classifications (server/fleet.py)."""
+        return web.json_response(json_clean(self.fleet.health_snapshot()))
 
     # -- distributed tracing -------------------------------------------
     def _round_trace_id(self, rid: str) -> str:
@@ -925,6 +1013,19 @@ class Experiment:
             round=round_name,
             outcome=outcome,
         )
+        # fold the round into the fleet ledger FIRST (rounds_log may be
+        # off): every cohort member gets a reported/straggler/missed
+        # observation, and non-reporters get a classification-backed
+        # "why" for the SLO record. Advisory plane — it must never be
+        # able to break round completion.
+        cohort, self._round_cohort = self._round_cohort, []
+        try:
+            straggler_why = self.fleet.record_round(
+                round_name, cohort, participants, responses
+            )
+        except Exception:
+            _log.exception("%s: fleet ledger record failed", self.name)
+            straggler_why = {}
         if self.rounds_log is None:
             return
         responses = responses or {}
@@ -954,6 +1055,7 @@ class Experiment:
             "participants": len(participants),
             "reporters": len(reporters),
             "stragglers": [c for c in participants if c not in responses],
+            "straggler_why": straggler_why,
             "bytes_uploaded": deltas.get("bytes_uploaded", 0.0),
             "bytes_broadcast": deltas.get("bytes_broadcast", 0.0),
             "counters_delta": deltas,
@@ -984,16 +1086,20 @@ class Experiment:
             )
         except (UnknownClient, AuthError):
             return web.json_response({"err": "Unauthorized"}, status=401)
+        t_read0 = time.monotonic()
         try:
             body = await read_body_capped(request, self.max_upload_bytes)
         except BodyTooLarge:
             self.metrics.inc("uploads_rejected_413")
             return web.json_response({"err": "Payload Too Large"}, status=413)
+        # server-side view of the upload wall time (body streaming in):
+        # the bandwidth denominator the ledger records per client
+        upload_s = time.monotonic() - t_read0
         self.metrics.inc("bytes_uploaded", len(body))
         ctx = tracing.parse_traceparent(request.headers.get("traceparent"))
         if ctx is None:
             return await self._ingest_update(
-                client_id, body, request.content_type
+                client_id, body, request.content_type, upload_s=upload_s
             )
         # join the caller's trace: the worker's upload span is the parent
         with self.tracer.span(
@@ -1001,7 +1107,7 @@ class Experiment:
             client=client_id, bytes=len(body),
         ):
             return await self._ingest_update(
-                client_id, body, request.content_type
+                client_id, body, request.content_type, upload_s=upload_s
             )
 
     def _make_upload_decoder(self, body: bytes, content_type):
@@ -1068,7 +1174,11 @@ class Experiment:
         return decode
 
     async def _ingest_update(
-        self, client_id: str, body: bytes, content_type
+        self,
+        client_id: str,
+        body: bytes,
+        content_type,
+        upload_s: Optional[float] = None,
     ) -> web.Response:
         """Accept one assembled upload body (single POST or completed
         chunk session): decode/validate off-loop, then run the round
@@ -1169,7 +1279,16 @@ class Experiment:
             "n_samples": meta_n_samples,
             "loss_history": meta_losses,
             "update_id": update_id,
+            "upload_bytes": len(body),
         }
+        # worker self-reported timings piggybacked on the update meta
+        # (train wall time, heartbeat RTT) + the server-measured upload
+        # wall: the fleet ledger's per-client observation fields
+        timings = _clean_timings(meta.get("timings")) or {}
+        if upload_s is not None and upload_s > 0:
+            timings["upload_s"] = round(upload_s, 6)
+        if timings:
+            response["timings"] = timings
         acc = self._stream_acc
         if acc is not None and not response["masked"]:
             # streaming FedAvg: acceptance bookkeeping FIRST (no await
@@ -1295,12 +1414,14 @@ class Experiment:
                     float(c.get("n_samples", 0)),
                     str(c["update_id"]) if c.get("update_id") else None,
                     [float(x) for x in (c.get("loss_history") or [])],
+                    int(c.get("bytes") or 0),
+                    _clean_timings(c.get("timings")),
                 )
                 for cid, c in sorted(contributors.items())
             ]
         except (AttributeError, TypeError, ValueError):
             return web.json_response({"err": "Bad Edge Partial"}, status=400)
-        for cid, w, uid, losses in parsed:
+        for cid, w, uid, losses, nbytes, timings in parsed:
             if not (w > 0) or not math.isfinite(w):
                 return web.json_response(
                     {"err": "Bad Edge Partial"}, status=400
@@ -1319,9 +1440,28 @@ class Experiment:
                 # folds but the credit stays with the direct delivery
                 self.metrics.inc("edge_contributor_conflicts")
                 continue
-            credited.append((cid, w, uid, losses))
+            credited.append((cid, w, uid, losses, nbytes, timings))
         if total_w <= 0:
             return web.json_response({"err": "Bad Edge Partial"}, status=400)
+        # edge-tier phase wall times ride the partial's meta: folded
+        # into float counters so each round's counters_delta (and thus
+        # rounds.jsonl) shows where edge time went this round
+        phase_s = info.get("phase_s")
+        if isinstance(phase_s, dict):
+            for key, counter in (
+                ("fold", "edge_phase_fold_s"),
+                ("blob_fetch", "edge_phase_blob_fetch_s"),
+                ("settle", "edge_phase_settle_s"),
+                ("ship_prev", "edge_phase_ship_prev_s"),
+            ):
+                val = phase_s.get(key)
+                if (
+                    isinstance(val, (int, float))
+                    and not isinstance(val, bool)
+                    and math.isfinite(val)
+                    and val >= 0
+                ):
+                    self.metrics.inc(counter, float(val))
         anchor = (
             self._broadcast_anchor_sd
             if self._broadcast_anchor_sd is not None
@@ -1333,15 +1473,20 @@ class Experiment:
         # — partial or direct — sees client_responses/_edge_partial_ids
         if update_id is not None:
             self._edge_partial_ids.add((client_id, update_id))
-        for cid, w, uid, losses in credited:
-            self.rounds.client_end(cid, {
+        for cid, w, uid, losses, nbytes, timings in credited:
+            resp = {
                 "masked": False,
                 "n_samples": w,
                 "loss_history": losses,
                 "update_id": uid,
                 "streamed": True,
                 "via_edge": edge_name,
-            })
+            }
+            if nbytes > 0:
+                resp["upload_bytes"] = nbytes
+            if timings:
+                resp["timings"] = timings
+            self.rounds.client_end(cid, resp)
             self.registry.record_update(cid, round_name)
             self.metrics.inc("updates_received")
             self.metrics.inc("edge_contributors_credited")
@@ -1686,6 +1831,9 @@ class Experiment:
             # params_to_state_dict is a full-model device-to-host copy
             self._broadcast_anchor_sd = state_dict
         cohort_ids = self._sample_cohort()
+        # remember the fan-out for the fleet ledger: a sampled client
+        # that never acks/reports is a "missed" observation at round end
+        self._round_cohort = list(cohort_ids)
         if self.secure_agg:
             # Bonawitz round 0 (AdvertiseKeys): per-round DH key
             # agreement. Clients that fail are excluded BEFORE the pk
@@ -2247,7 +2395,10 @@ class Experiment:
         started_wall = self.rounds.started_wall
         participants = set(self.rounds.clients)
         trace_id = tracing.make_trace_id(self.name, round_name)
-        self.metrics.observe("round_s", self.rounds.elapsed)
+        self.metrics.observe(
+            "round_s", self.rounds.elapsed,
+            exemplar=(trace_id, tracing.root_span_id(trace_id)),
+        )
         acc, self._stream_acc = self._stream_acc, None
         if self._ingest is not None:
             # an accepted update's 200 promised its fold would land in
@@ -2495,7 +2646,11 @@ class Experiment:
             if dropped:
                 self.metrics.inc("secure_dropouts_recovered", len(dropped))
             n_epoch = (self.rounds.round_meta or {}).get("n_epoch", 0)
-            self.metrics.observe("round_s", self.rounds.elapsed)
+            _rt = tracing.make_trace_id(self.name, round_name)
+            self.metrics.observe(
+                "round_s", self.rounds.elapsed,
+                exemplar=(_rt, tracing.root_span_id(_rt)),
+            )
             self.rounds.end_round()
             self.metrics.inc("rounds_finished")
             w = sum(float(r["n_samples"]) for r in reports)

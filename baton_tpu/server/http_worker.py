@@ -200,6 +200,9 @@ class ExperimentWorker:
         self.name = name or getattr(model, "name", "fedmodel")
         self.model = model
         self.metrics = Metrics()
+        # last successful heartbeat round-trip, piggybacked on update
+        # metadata so the manager's fleet ledger sees link latency
+        self._last_hb_rtt: Optional[float] = None
         # span recorder for this worker's half of each round's trace;
         # the label is upgraded to the registered client_id so traces
         # name workers the way the manager's round state does
@@ -411,6 +414,7 @@ class ExperimentWorker:
             try:
                 # time only the round-trip: the 401 path's re-register
                 # (with its own retry backoff) would skew the histogram
+                t_hb0 = time.perf_counter()
                 with self.metrics.timer("heartbeat_s"):
                     async with self._session.get(
                         url,
@@ -418,6 +422,7 @@ class ExperimentWorker:
                     ) as resp:
                         status = resp.status
                 if status == 200:
+                    self._last_hb_rtt = time.perf_counter() - t_hb0
                     return
                 if status == 401:
                     # manager restarted or culled us: rejoin
@@ -1149,20 +1154,37 @@ class ExperimentWorker:
                     )
                     train_sp.set(time_scale=self.train_time_scale)
                     await asyncio.sleep(extra)
+                train_s = loop.time() - t_train0
                 if len(loss_history):
                     train_sp.set(final_loss=float(loss_history[-1]))
+                # observed inside the span so the histogram exemplar
+                # carries this round's local_train span context
+                self.metrics.observe(
+                    "local_train_s", train_s,
+                    exemplar=tracing.current_context(),
+                )
             self.params = params
-            await self.report_update(round_name, n_samples, loss_history)
+            await self.report_update(
+                round_name, n_samples, loss_history,
+                timings={
+                    "train_s": train_s,
+                    "hb_rtt_s": self._last_hb_rtt,
+                },
+            )
         finally:
             self.round_in_progress = False
 
     async def report_update(
-        self, round_name: str, n_samples: int, loss_history
+        self, round_name: str, n_samples: int, loss_history,
+        timings: Optional[dict] = None,
     ) -> None:
         """Encode the trained update and park it in the outbox; actual
         delivery (with retries) happens in :meth:`_drain_outbox`. Returns
         as soon as the slot is filled, so the caller's round bookkeeping
-        never waits on the network."""
+        never waits on the network. ``timings`` (self-reported seconds,
+        e.g. ``{"train_s": …, "hb_rtt_s": …}``) ride along in the update
+        metadata for the manager's fleet ledger — advisory data, so None
+        entries are simply dropped rather than sent."""
         update_id = random_key(16)
         meta = {
             "update_name": round_name,
@@ -1170,6 +1192,14 @@ class ExperimentWorker:
             "loss_history": [float(x) for x in loss_history],
             "update_id": update_id,
         }
+        if timings:
+            cleaned = {
+                k: round(float(v), 6)
+                for k, v in timings.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+            if cleaned:
+                meta["timings"] = cleaned
         # use the secure state captured AT BROADCAST TIME, not a fresh
         # registry fetch: if the round was re-keyed since (abort/restart
         # reusing the name mid-round), a fresh fetch returns the NEW
@@ -1465,11 +1495,19 @@ class ExperimentWorker:
             attempt=p.attempts + 1, chunked=chunked,
             via_edge=via_edge,
         ) as up_sp:
+            t_up0 = time.perf_counter()
             if chunked:
                 status, retry_after = await self._post_update_chunked(
                     p, base_url
                 )
                 up_sp.set(status=status)
+                if status == 200:
+                    # successful deliveries only: a refused or retried
+                    # attempt's wall time is backoff, not bandwidth
+                    self.metrics.observe(
+                        "upload_s", time.perf_counter() - t_up0,
+                        exemplar=tracing.current_context(),
+                    )
                 if status is None and via_edge:
                     self._edge_failed()
                 return status, retry_after
@@ -1485,6 +1523,11 @@ class ExperimentWorker:
                     ),
                 ) as resp:
                     up_sp.set(status=resp.status)
+                    if resp.status == 200:
+                        self.metrics.observe(
+                            "upload_s", time.perf_counter() - t_up0,
+                            exemplar=tracing.current_context(),
+                        )
                     if resp.status == 409 and via_edge:
                         # the edge refused to fold (secure round, round
                         # unknown): mark the route down so the outbox's

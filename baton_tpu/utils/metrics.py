@@ -19,8 +19,9 @@ import asyncio
 import bisect
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 # ---------------------------------------------------------------------------
 # Declared metric registries.
@@ -126,6 +127,15 @@ DECLARED_COUNTERS = frozenset({
     "scenario_warmup_rounds",
     "scenario_edges_started",
     "scenario_edges_killed",
+    # fleet health plane (server/fleet.py ledger)
+    "fleet_observations",
+    "history_snapshots",
+    # manager: edge-tier phase wall times folded into round counter
+    # deltas (float seconds; shipped per round in the partial's meta)
+    "edge_phase_fold_s",
+    "edge_phase_blob_fetch_s",
+    "edge_phase_settle_s",
+    "edge_phase_ship_prev_s",
 })
 
 DECLARED_COUNTER_PREFIXES = (
@@ -147,6 +157,20 @@ DECLARED_TIMERS = frozenset({
     "edge_blob_fetch_s",    # edge: root blob fetch on cohort cache miss
     "edge_partial_ship_s",  # edge: partial upload to root, end to end
     "edge_relay_s",         # edge: root→worker notify/secure relay hop
+    # fleet health plane
+    "local_train_s",    # worker: self-measured local training wall time
+    "upload_s",         # worker: one update POST end to end
+})
+
+# Timers whose histogram must carry a trace exemplar: every direct
+# ``observe()`` on these names is required (batonlint BTL032) to pass
+# the active span context via ``exemplar=``, so a p99 spike on
+# ``/metrics`` always links to a fetchable round trace. Plain literal —
+# the linter parses this with ast.literal_eval like the sets above.
+DECLARED_EXEMPLAR_TIMERS = frozenset({
+    "round_s",
+    "local_train_s",
+    "upload_s",
 })
 
 # Gauges set under baton_tpu/server/ (BTL030 audits .set_gauge() names).
@@ -177,6 +201,14 @@ DECLARED_GAUGES = frozenset({
     "scenario_workers_alive",
     "scenario_phase_index",
     "scenario_availability",
+    # fleet health plane: advisory per-class client counts
+    # (server/fleet.py classifications exported by the manager/edges)
+    "fleet_clients_total",
+    "fleet_clients_healthy",
+    "fleet_clients_slow",
+    "fleet_clients_flaky",
+    "fleet_clients_degrading",
+    "fleet_clients_inactive",
 })
 
 
@@ -185,11 +217,17 @@ DECLARED_GAUGES = frozenset({
 _BUCKET_RATIO = 2.0 ** 0.5
 _BUCKET_BOUNDS = tuple(1e-4 * _BUCKET_RATIO ** i for i in range(48))
 
+# How long a timer holds on to its worst-observation exemplar before a
+# smaller observation may replace it: long enough to survive a scrape
+# interval, short enough that a stale p99 trace link ages out.
+_EXEMPLAR_TTL_S = 60.0
+
 
 class _TimerStat:
     """One timer's fixed-bucket histogram plus the legacy scalar stats."""
 
-    __slots__ = ("count", "total", "min", "max", "last", "buckets")
+    __slots__ = ("count", "total", "min", "max", "last", "buckets",
+                 "exemplar")
 
     def __init__(self) -> None:
         self.count = 0
@@ -198,14 +236,36 @@ class _TimerStat:
         self.max = 0.0
         self.last = 0.0
         self.buckets: List[int] = [0] * (len(_BUCKET_BOUNDS) + 1)
+        # worst recent observation's span context: {"seconds", "trace_id",
+        # "span_id", "ts"} — the /metrics link from a p99 spike to the
+        # round trace that produced it
+        self.exemplar: Optional[dict] = None
 
-    def observe(self, seconds: float) -> None:
+    def observe(
+        self,
+        seconds: float,
+        exemplar: Optional[Tuple[str, str]] = None,
+    ) -> None:
         self.count += 1
         self.total += seconds
         self.min = min(self.min, seconds)
         self.max = max(self.max, seconds)
         self.last = seconds
         self.buckets[bisect.bisect_left(_BUCKET_BOUNDS, seconds)] += 1
+        if exemplar is not None:
+            ex = self.exemplar
+            now = time.time()
+            if (
+                ex is None
+                or seconds >= ex["seconds"]
+                or now - ex["ts"] > _EXEMPLAR_TTL_S
+            ):
+                self.exemplar = {
+                    "seconds": seconds,
+                    "trace_id": exemplar[0],
+                    "span_id": exemplar[1],
+                    "ts": now,
+                }
 
     def quantile(self, q: float) -> float:
         """Histogram quantile with linear interpolation inside the
@@ -232,7 +292,7 @@ class _TimerStat:
         return self.max
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "count": self.count,
             "total_s": self.total,
             "mean_s": self.total / self.count if self.count else 0.0,
@@ -243,13 +303,17 @@ class _TimerStat:
             "p95_s": self.quantile(0.95),
             "p99_s": self.quantile(0.99),
         }
+        if self.exemplar is not None:
+            out["exemplar"] = dict(self.exemplar)
+        return out
 
 
 class Metrics:
-    def __init__(self) -> None:
+    def __init__(self, history_limit: int = 240) -> None:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, float] = {}
         self._timers: Dict[str, _TimerStat] = {}
+        self._history: deque = deque(maxlen=max(2, history_limit))
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------------
@@ -261,12 +325,21 @@ class Metrics:
         with self._lock:
             self._gauges[name] = float(value)
 
-    def observe(self, name: str, seconds: float) -> None:
+    def observe(
+        self,
+        name: str,
+        seconds: float,
+        exemplar: Optional[Tuple[str, str]] = None,
+    ) -> None:
+        """Record one histogram observation. ``exemplar`` is the active
+        ``(trace_id, span_id)`` pair (``tracing.current_context()``) —
+        required on timers in :data:`DECLARED_EXEMPLAR_TIMERS` so the
+        worst recent observation links back to its round trace."""
         with self._lock:
             stat = self._timers.get(name)
             if stat is None:
                 stat = self._timers[name] = _TimerStat()
-            stat.observe(seconds)
+            stat.observe(seconds, exemplar=exemplar)
 
     @contextmanager
     def timer(self, name: str):
@@ -274,7 +347,11 @@ class Metrics:
         try:
             yield
         finally:
-            self.observe(name, time.perf_counter() - t0)
+            # auto-capture the span context active at exit: timers used
+            # under a `with tracer.span(...)` get exemplars for free
+            from baton_tpu.utils import tracing
+            self.observe(name, time.perf_counter() - t0,
+                         exemplar=tracing.current_context())
 
     # ------------------------------------------------------------------
     def snapshot(self) -> dict:
@@ -284,6 +361,31 @@ class Metrics:
                 "gauges": dict(self._gauges),
                 "timers": {k: v.to_json() for k, v in self._timers.items()},
             }
+
+    # ------------------------------------------------------------------
+    def record_history(
+        self,
+        ts: Optional[float] = None,
+        snapshot: Optional[dict] = None,
+    ) -> dict:
+        """Append a timestamped snapshot to the bounded history ring
+        (``GET /{name}/metrics/history``) so scrapers and the SLO
+        evaluator can compute rates and windowed deltas without
+        maintaining their own state. ``snapshot`` lets the caller record
+        a DERIVED snapshot (extra computed gauges) instead of the raw
+        registry. Returns the recorded entry."""
+        snap = dict(snapshot) if snapshot is not None else self.snapshot()
+        snap["ts"] = round(time.time() if ts is None else ts, 6)
+        with self._lock:
+            self._history.append(snap)
+            n = len(self._history)
+        self.inc("history_snapshots")
+        return dict(snap, samples=n)
+
+    def history(self) -> List[dict]:
+        """The recorded snapshot ring, oldest first."""
+        with self._lock:
+            return list(self._history)
 
 
 class LoopLagProbe:

@@ -61,7 +61,8 @@ class _SilentWorker(ExperimentWorker):
     """Registers and advertises keys, then never uploads — the dropout
     whose pairwise masks the survivors must reconstruct."""
 
-    async def report_update(self, round_name, n_samples, loss_history):
+    async def report_update(self, round_name, n_samples, loss_history,
+                            **kw):
         return None
 
 

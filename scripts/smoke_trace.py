@@ -1,23 +1,31 @@
-"""CI smoke round with distributed tracing: one root manager, two edge
-aggregators, and 2 in-process workers (one per edge) over real loopback
-sockets, one federated round end to end, then export the round's merged
-trace and SLO record as build artifacts.
+"""CI smoke round with distributed tracing + the fleet health plane:
+one root manager, two edge aggregators, and 4 in-process workers (two
+per edge, one slowed 8x) over real loopback sockets, three federated
+rounds end to end, then export the round trace, fleet health, metric
+history, and SLO records as build artifacts.
 
 Artifacts (``--artifacts DIR``, default ``./artifacts``):
 
 * ``round_trace.json``  — Chrome ``trace_event`` export of the round
-  (drop it into Perfetto / chrome://tracing); spans from all THREE
-  tiers — manager, edges, workers — merged by traceparent;
-* ``rounds.jsonl``      — the per-round SLO records;
+  the ``local_train`` p99 exemplar points at (drop it into Perfetto /
+  chrome://tracing); spans from all THREE tiers merged by traceparent;
+* ``rounds.jsonl``      — the per-round SLO records (now with
+  ``straggler_why`` classification reasons);
 * ``manager_metrics.json`` — the manager's full metrics snapshot
-  (histogram timers with p50/p95/p99);
-* ``edge_metrics.json`` — both edges' metrics snapshots.
+  (histogram timers with p50/p95/p99 and trace exemplars);
+* ``edge_metrics.json`` — both edges' metrics snapshots;
+* ``fleet_health.json`` — ``GET /fleet/health`` from the root and both
+  edges (per-client anomaly classifications);
+* ``metrics_history.json`` — ``GET /metrics/history`` from all three
+  nodes (the timestamped snapshot rings);
+* ``ops_console.json``  — one ``python -m baton_tpu.ops --once --json``
+  poll of the live federation.
 
-Exits non-zero if the round fails, the trace is missing spans from any
-tier of the federation (the edge hop must carry the traceparent both
-ways), or the SLO record is absent — so a CI run that silently breaks
-traceparent propagation fails here rather than in a dashboard weeks
-later.
+Exits non-zero if a round fails, the trace is missing spans from any
+tier, the 8x-slowed worker is not classified ``slow``, the round
+record does not name it with a reason, the ``local_train_s`` exemplar
+does not resolve to a fetchable trace containing that worker's span,
+or the ops console probe fails.
 
 Run locally:  JAX_PLATFORMS=cpu python scripts/smoke_trace.py
 """
@@ -43,6 +51,8 @@ from baton_tpu.models.linear import linear_regression_model  # noqa: E402
 from baton_tpu.server.edge import EdgeAggregator  # noqa: E402
 from baton_tpu.server.http_manager import Manager  # noqa: E402
 from baton_tpu.server.http_worker import ExperimentWorker  # noqa: E402
+from baton_tpu.utils import tracing  # noqa: E402
+from baton_tpu.utils.faults import FaultInjector  # noqa: E402
 from baton_tpu.utils.slog import setup_json_logging  # noqa: E402
 
 
@@ -60,18 +70,46 @@ async def _wait(cond, n=600, dt=0.05):
     return cond()
 
 
+async def _get_json(session, url):
+    async with session.get(url) as resp:
+        assert resp.status == 200, (url, resp.status, await resp.text())
+        return await resp.json()
+
+
+async def _run_console_once(mport, name, edge_ports):
+    """``python -m baton_tpu.ops --once --json`` against the live
+    federation — the CI probe mode the console exists for."""
+    edges = ",".join(
+        f"http://127.0.0.1:{p}/{name}" for p in edge_ports
+    )
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "baton_tpu.ops",
+        "--root", f"http://127.0.0.1:{mport}/{name}",
+        "--edges", edges, "--once", "--json",
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.PIPE,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    out, err = await asyncio.wait_for(proc.communicate(), timeout=120)
+    assert proc.returncode == 0, (proc.returncode, err.decode()[-2000:])
+    return json.loads(out.decode())
+
+
 async def _smoke(artifacts: str) -> int:
     import aiohttp
 
     name, mport, dim = "smoke", _free_port(), 10
     trace_dir = os.path.join(artifacts, "trace_spool")
     rounds_path = os.path.join(artifacts, "rounds.jsonl")
+    clients_path = os.path.join(artifacts, "clients.jsonl")
 
     model = linear_regression_model(dim)
     mapp = web.Application()
     exp = Manager(mapp).register_experiment(
         model, name=name,
         trace_dir=trace_dir, rounds_log_path=rounds_path,
+        clients_log_path=clients_path,
+        metrics_history_interval_s=0.5,
     )
     mrunner = web.AppRunner(mapp)
     await mrunner.setup()
@@ -88,6 +126,7 @@ async def _smoke(artifacts: str) -> int:
         edge = EdgeAggregator(
             eapp, f"127.0.0.1:{mport}", name=name, port=eport,
             edge_name=f"e{i}", ship_settle_s=0.05, heartbeat_time=5.0,
+            metrics_history_interval_s=0.5,
         )
         erunner = web.AppRunner(eapp)
         await erunner.setup()
@@ -99,12 +138,22 @@ async def _smoke(artifacts: str) -> int:
                                  batch_size=32, learning_rate=0.02)
     nprng = np.random.default_rng(0)
     workers = []
-    # one plain worker, one chunk-uploading worker — both upload paths
-    # must carry the traceparent; each routes through its own edge
-    for i, chunk in enumerate((None, 1 << 12)):
+    # four workers, two per edge: one chunk-uploading (both upload
+    # paths must carry the traceparent) and one slowed 8x — the fleet
+    # health plane must classify it `slow` from its self-reported
+    # train timings. The last worker also carries a gated 503 fault so
+    # round 3 can show a classification-backed straggler_why.
+    slow_gate = {"on": False}
+    for i, (chunk, scale) in enumerate(
+        ((None, 1.0), (1 << 12, 1.0), (None, 1.0), (None, 8.0))
+    ):
         wport = _free_port()
         data = linear_client_data(nprng, min_batches=2, max_batches=2)
-        wapp = web.Application()
+        inj = FaultInjector()
+        wapp = web.Application(middlewares=[inj.middleware])
+        if scale > 1.0:
+            inj.error("round_start", status=503,
+                      gate=lambda: slow_gate["on"])
         w = ExperimentWorker(
             wapp, model, f"127.0.0.1:{mport}",
             name=name, port=wport, heartbeat_time=0.5,
@@ -112,42 +161,107 @@ async def _smoke(artifacts: str) -> int:
             get_data=lambda d=data: (d, d["x"].shape[0]),
             outbox_backoff=(0.05, 0.4),
             upload_chunk_bytes=chunk,
-            edge=f"127.0.0.1:{edges[i].port}",
+            train_time_scale=scale,
+            edge=f"127.0.0.1:{edges[i % 2].port}",
         )
         wrunner = web.AppRunner(wapp)
         await wrunner.setup()
         await web.TCPSite(wrunner, "127.0.0.1", wport).start()
         workers.append(w)
         runners.append(wrunner)
+    slow_worker = workers[3]
 
     ok = True
     try:
-        # 2 workers + 2 edges (each edge holds a client entry of its own)
-        assert await _wait(lambda: len(exp.registry) == 4), \
+        # 4 workers + 2 edges (each edge holds a client entry of its own)
+        assert await _wait(lambda: len(exp.registry) == 6), \
             "workers/edges did not register"
         async with aiohttp.ClientSession() as session:
-            async with session.get(
-                f"http://127.0.0.1:{mport}/{name}/start_round?n_epoch=2"
-            ) as resp:
-                assert resp.status == 200, await resp.text()
-        assert await _wait(lambda: exp.rounds.n_rounds == 1, n=1200), \
-            "round did not complete"
-        # worker spans arrive via the async upstream ship
-        assert await _wait(lambda: all(
-            w.metrics.snapshot()["counters"].get("trace_spans_shipped", 0)
-            for w in workers
-        )), "worker spans were not shipped"
+            # three rounds: 1-2 give the slow worker a reported train_s
+            # history (=> `slow` classification), in 3 it refuses the
+            # notify (503) so the round record's straggler_why has to
+            # explain the miss FROM that history
+            for rnd in range(3):
+                slow_gate["on"] = rnd == 2
+                before = exp.rounds.n_rounds
+                async with session.get(
+                    f"http://127.0.0.1:{mport}/{name}"
+                    "/start_round?n_epoch=2"
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+                assert await _wait(
+                    lambda: exp.rounds.n_rounds > before, n=1200
+                ), f"round {rnd} did not complete"
+            slow_gate["on"] = False
+            # worker spans arrive via the async upstream ship
+            assert await _wait(lambda: all(
+                w.metrics.snapshot()["counters"].get(
+                    "trace_spans_shipped", 0
+                )
+                for w in workers
+            )), "worker spans were not shipped"
 
-        async with aiohttp.ClientSession() as session:
-            async with session.get(
-                f"http://127.0.0.1:{mport}/{name}/rounds/0/trace"
-            ) as resp:
-                assert resp.status == 200, await resp.text()
-                trace = await resp.json()
-            async with session.get(
-                f"http://127.0.0.1:{mport}/{name}/metrics"
-            ) as resp:
-                metrics = await resp.json()
+            # -- fleet health plane ---------------------------------
+            base = f"http://127.0.0.1:{mport}/{name}"
+            health = {"root": await _get_json(session,
+                                              f"{base}/fleet/health")}
+            history = {"root": await _get_json(
+                session, f"{base}/metrics/history"
+            )}
+            for e in edges:
+                ebase = f"http://127.0.0.1:{e.port}/{name}"
+                health[e.edge_name] = await _get_json(
+                    session, f"{ebase}/fleet/health"
+                )
+                history[e.edge_name] = await _get_json(
+                    session, f"{ebase}/metrics/history"
+                )
+
+            sick = health["root"]["clients"].get(slow_worker.client_id)
+            assert sick is not None, health["root"]["clients"].keys()
+            assert sick["status"] == "slow", sick
+            assert "train_s median" in sick["reason"], sick
+            for node, h in health.items():
+                assert h["summary"]["total"] >= 1, (node, h)
+            for node, h in history.items():
+                assert h["samples"] >= 1, (node, h)
+
+            # the slow worker's local_train_s p99 exemplar must point
+            # at a fetchable round trace containing its span
+            wt = slow_worker.metrics.snapshot()["timers"]
+            ex = wt["local_train_s"].get("exemplar")
+            assert ex and ex.get("trace_id"), wt["local_train_s"]
+            with open(rounds_path) as fh:
+                records = [json.loads(ln) for ln in fh if ln.strip()]
+            by_trace = {
+                tracing.make_trace_id(name, r["round"]): r["round"]
+                for r in records
+            }
+            ex_round = by_trace.get(ex["trace_id"])
+            assert ex_round is not None, (ex, sorted(by_trace.values()))
+            trace = await _get_json(
+                session, f"{base}/rounds/{ex_round}/trace"
+            )
+            dump = json.dumps(trace)
+            assert "local_train" in dump, "exemplar trace has no train"
+            assert slow_worker.client_id in dump, \
+                "exemplar trace is missing the slow worker's span"
+
+            metrics = await _get_json(session, f"{base}/metrics")
+
+        # round 3's record must NAME the refusing worker with a
+        # classification-backed reason derived from rounds 1-2
+        why = records[-1].get("straggler_why") or {}
+        assert slow_worker.client_id in why, (why, records[-1])
+        assert why[slow_worker.client_id].startswith("slow:"), why
+
+        # -- ops console (CI probe mode) ----------------------------
+        console = await _run_console_once(
+            mport, name, [e.port for e in edges]
+        )
+        assert console["root"]["up"], console["root"]
+        assert all(e["up"] for e in console["edges"]), console["edges"]
+        assert console["root"]["health"]["clients"], console["root"]
 
         with open(os.path.join(artifacts, "round_trace.json"), "w") as fh:
             json.dump(trace, fh, indent=2)
@@ -158,6 +272,15 @@ async def _smoke(artifacts: str) -> int:
                   "w") as fh:
             json.dump({e.edge_name: e.metrics.snapshot() for e in edges},
                       fh, indent=2)
+        with open(os.path.join(artifacts, "fleet_health.json"),
+                  "w") as fh:
+            json.dump(health, fh, indent=2)
+        with open(os.path.join(artifacts, "metrics_history.json"),
+                  "w") as fh:
+            json.dump(history, fh, indent=2)
+        with open(os.path.join(artifacts, "ops_console.json"),
+                  "w") as fh:
+            json.dump(console, fh, indent=2)
 
         services = {
             e["args"]["name"]
@@ -167,29 +290,32 @@ async def _smoke(artifacts: str) -> int:
             e["name"] for e in trace["traceEvents"] if e["ph"] == "X"
         }
         assert any(s.startswith("manager#") for s in services), services
-        assert sum(s.startswith("worker:") for s in services) == 2, services
         assert sum(s.startswith("edge:") for s in services) == 2, services
         for want in ("round", "round_setup", "notify", "local_train",
                      "upload", "ingest", "aggregate", "edge_relay",
                      "edge_partial_upload"):
             assert want in span_names, (want, span_names)
         mc = metrics["counters"]
-        assert mc.get("updates_received_edge_partial") == 2, mc
-        assert mc.get("updates_received") == 2, mc
+        # 2 partials per round x 3 rounds (each edge ships one)
+        assert mc.get("updates_received_edge_partial") == 6, mc
+        assert mc.get("fleet_observations", 0) > 0, mc
         for e in edges:
             ec = e.metrics.snapshot()["counters"]
-            assert ec.get("edge_partials_shipped") == 1, (e.edge_name, ec)
-            assert ec.get("edge_updates_folded") == 1, (e.edge_name, ec)
+            assert ec.get("edge_partials_shipped") == 3, (e.edge_name, ec)
         for tname, st in metrics["timers"].items():
             assert {"p50_s", "p95_s", "p99_s"} <= set(st), tname
-        with open(rounds_path) as fh:
-            records = [json.loads(ln) for ln in fh if ln.strip()]
-        assert len(records) == 1 and records[0]["outcome"] == "completed", \
-            records
+        # round_s carries a round-trace exemplar too
+        assert metrics["timers"]["round_s"].get("exemplar"), \
+            metrics["timers"]["round_s"]
+        assert len(records) == 3 and all(
+            r["outcome"] == "completed" for r in records
+        ), records
+        assert os.path.exists(clients_path), "clients.jsonl not written"
         print(f"smoke ok: {len(span_names)} span kinds from "
-              f"{len(services)} services; round "
-              f"{records[0]['round']} {records[0]['duration_s']:.2f}s, "
-              f"phases={sorted(records[0]['phase_s'])}")
+              f"{len(services)} services; {len(records)} rounds; "
+              f"slow worker {slow_worker.client_id} classified "
+              f"`{sick['status']}` ({sick['reason']}); "
+              f"why[round3]={why[slow_worker.client_id]!r}")
     except AssertionError as exc:
         print(f"SMOKE FAILED: {exc}", file=sys.stderr)
         ok = False

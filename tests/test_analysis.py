@@ -899,11 +899,67 @@ def test_suppression_at_lock_header_covers_block():
 # engine plumbing
 
 
+# ----------------------------------------------------------------------
+# BTL032 — exemplar-declared timers must observe with span context
+
+EXEMPLAR_REGISTRY = dict(DICT_REGISTRY,
+                         exemplar_timers=frozenset({"round_s"}))
+
+
+def test_btl032_bare_observe_and_literal_none_flagged():
+    findings = lint(
+        """
+        def f(m, dt):
+            m.observe("round_s", dt)
+            m.observe("round_s", dt, exemplar=None)
+        """,
+        rules=["BTL032"],
+        registry=EXEMPLAR_REGISTRY,
+    )
+    assert rules_of(findings) == ["BTL032", "BTL032"]
+    assert "no exemplar=" in findings[0].message
+    assert "hardcodes" in findings[1].message
+
+
+def test_btl032_context_kwarg_positional_and_undeclared_pass():
+    findings = lint(
+        """
+        def f(m, dt, tracing, ctx):
+            m.observe("round_s", dt, exemplar=tracing.current_context())
+            m.observe("round_s", dt, ctx)  # third positional works too
+            m.observe("fold_s", dt)  # not exemplar-declared
+        """,
+        rules=["BTL032"],
+        registry=EXEMPLAR_REGISTRY,
+    )
+    assert findings == []
+
+
+def test_btl032_scoped_and_suppressible():
+    src = """
+    def f(m, dt):
+        m.observe("round_s", dt)
+    """
+    # utils/ code (the timer machinery itself) is out of scope …
+    assert lint(src, path="baton_tpu/utils/fixture.py",
+                rules=["BTL032"], registry=EXEMPLAR_REGISTRY) == []
+    # … registries without the exemplar set disable the audit …
+    assert lint(src, rules=["BTL032"], registry=DICT_REGISTRY) == []
+    assert lint(src, rules=["BTL032"], registry=REGISTRY) == []
+    # … and a genuinely context-free site can be suppressed inline
+    suppressed = """
+    def f(m, dt):
+        m.observe("round_s", dt)  # batonlint: allow[BTL032]
+    """
+    assert lint(suppressed, rules=["BTL032"],
+                registry=EXEMPLAR_REGISTRY) == []
+
+
 def test_all_rules_table():
     table = all_rules()
     assert set(table) == {
         "BTL001", "BTL002", "BTL003", "BTL010", "BTL020", "BTL030",
-        "BTL031",
+        "BTL031", "BTL032",
     }
     assert all(table.values())
 

@@ -1,0 +1,428 @@
+"""Fleet health plane: the anomaly-scoring edges as pure unit tests
+(constant history, single sample, step change, flapping, MAD-floor
+outliers), the ledger's ring/persistence/why-map mechanics, the
+``history:*`` SLO derivation, and an e2e federation where an 8x-slowed
+worker is classified ``slow`` within three rounds and a
+503-unavailable-then-revived worker turns ``flaky`` — without either
+ever being evicted.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+from aiohttp import web
+
+from baton_tpu.core.training import make_local_trainer
+from baton_tpu.data.synthetic import linear_client_data
+from baton_tpu.loadgen.slo import derive_history_metrics
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.server.edge import EdgeAggregator
+from baton_tpu.server.fleet import (
+    ClientLedger,
+    DEGRADE_MIN_OBS,
+    FLAKY_MIN_MISSES,
+    SLOW_MIN_FLEET,
+    SLOW_Z,
+    STATUSES,
+    classify_client,
+    robust_zscore,
+)
+from baton_tpu.server.http_manager import Manager
+from baton_tpu.server.http_worker import ExperimentWorker
+from baton_tpu.utils.faults import FaultInjector
+from baton_tpu.utils.metrics import Metrics
+
+
+def _obs(outcome="reported", train_s=None, **extra):
+    entry = {"outcome": outcome}
+    if train_s is not None:
+        entry["train_s"] = train_s
+    entry.update(extra)
+    return entry
+
+
+# ----------------------------------------------------------------------
+# robust_zscore
+
+
+def test_robust_zscore_empty_population_is_zero():
+    assert robust_zscore(1.0, []) == 0.0
+
+
+def test_robust_zscore_median_value_scores_zero():
+    assert robust_zscore(2.0, [1.0, 2.0, 3.0]) == 0.0
+
+
+def test_robust_zscore_uniform_population_mad_floor():
+    # MAD is exactly zero; the 5%-of-median floor must keep the score
+    # finite and the 8x outlier loudly above any sane threshold
+    z = robust_zscore(0.8, [0.1, 0.1, 0.1, 0.1])
+    assert np.isfinite(z)
+    assert z > SLOW_Z * 10
+
+
+def test_robust_zscore_scales_with_spread():
+    tight = robust_zscore(2.0, [1.0, 1.01, 0.99, 1.0])
+    loose = robust_zscore(2.0, [1.0, 1.5, 0.5, 1.0])
+    assert tight > loose > 0
+
+
+# ----------------------------------------------------------------------
+# classify_client edges
+
+
+def test_classify_empty_window_inactive():
+    assert classify_client([], []) == ("inactive", "no observations")
+
+
+def test_classify_never_participated_inactive():
+    win = [_obs("missed") for _ in range(5)]
+    status, reason = classify_client(win, [])
+    assert status == "inactive"
+    assert "no participation" in reason
+
+
+def test_classify_constant_history_healthy():
+    win = [_obs(train_s=0.5) for _ in range(10)]
+    assert classify_client(win, [0.5, 0.5, 0.5, 0.5])[0] == "healthy"
+
+
+def test_classify_single_sample_small_fleet_healthy():
+    # one report, fewer than SLOW_MIN_FLEET medians: no cross-sectional
+    # judgement is possible, so even a huge value stays healthy
+    win = [_obs(train_s=100.0)]
+    fleet = [100.0] * (SLOW_MIN_FLEET - 1)
+    assert classify_client(win, fleet)[0] == "healthy"
+
+
+def test_classify_slow_outlier():
+    win = [_obs(train_s=0.8) for _ in range(3)]
+    status, reason = classify_client(win, [0.1, 0.1, 0.1, 0.8])
+    assert status == "slow"
+    assert "train_s median" in reason and "z=" in reason
+
+
+def test_classify_step_change_degrading():
+    # own-history trend: older half fast, recent half 4x slower. The
+    # fleet median matches the recent value so "slow" cannot fire and
+    # the trend detector must catch it.
+    n = DEGRADE_MIN_OBS
+    win = [_obs(train_s=0.1) for _ in range(n // 2)]
+    win += [_obs(train_s=0.4) for _ in range(n - n // 2)]
+    status, reason = classify_client(win, [0.25, 0.25, 0.25])
+    assert status == "degrading"
+    assert "->" in reason
+
+
+def test_classify_tiny_absolute_step_is_noise():
+    # ratio over DEGRADE_RATIO but the absolute delta is microseconds —
+    # below DEGRADE_MIN_DELTA_S it must stay healthy
+    win = [_obs(train_s=0.0001) for _ in range(3)]
+    win += [_obs(train_s=0.0004) for _ in range(3)]
+    assert classify_client(win, [0.00025, 0.00025, 0.00025])[0] == "healthy"
+
+
+def test_classify_flapping_flaky():
+    win = []
+    for i in range(6):
+        win.append(_obs("reported", train_s=0.1) if i % 2 else
+                   _obs("missed"))
+    status, reason = classify_client(win, [0.1, 0.1, 0.1])
+    assert status == "flaky"
+    assert "3 of last 6" in reason
+
+
+def test_classify_flaky_trumps_slow():
+    # a slow client that is also missing rounds: availability is the
+    # more actionable signal, so flaky wins
+    win = [_obs(train_s=5.0), _obs("missed"), _obs("missed"),
+           _obs(train_s=5.0)]
+    assert classify_client(win, [0.1, 0.1, 0.1, 5.0])[0] == "flaky"
+
+
+def test_classify_one_miss_not_flaky():
+    win = [_obs(train_s=0.1) for _ in range(FLAKY_MIN_MISSES * 3)]
+    win.append(_obs("straggler"))
+    assert classify_client(win, [0.1, 0.1, 0.1])[0] == "healthy"
+
+
+# ----------------------------------------------------------------------
+# ClientLedger mechanics
+
+
+def test_ledger_ring_is_bounded():
+    led = ClientLedger(window=4)
+    for i in range(10):
+        led.observe("c1", f"r{i}", "reported", train_s=0.1)
+    info = led.classify_all()["c1"]
+    assert info["rounds_seen"] == 4
+    assert info["last_round"] == "r9"
+
+
+def test_ledger_observe_derives_bandwidth_and_counts():
+    metrics = Metrics()
+    led = ClientLedger(window=8, metrics=metrics)
+    entry = led.observe("c1", "r0", "reported", train_s=0.25,
+                        upload_bytes=1 << 20, upload_s=0.5, loss=1.5)
+    assert entry["upload_bw_bps"] == (1 << 20) / 0.5
+    assert metrics.snapshot()["counters"]["fleet_observations"] == 1
+
+
+def test_ledger_persists_crash_safe_jsonl(tmp_path):
+    path = str(tmp_path / "clients.jsonl")
+    led = ClientLedger(window=8, log_path=path)
+    led.observe("c1", "r0", "reported", train_s=0.1)
+    led.observe("c2", "r0", "missed")
+    lines = [json.loads(ln) for ln in open(path) if ln.strip()]
+    assert [ln["client"] for ln in lines] == ["c1", "c2"]
+    assert lines[0]["train_s"] == 0.1
+    assert lines[1]["outcome"] == "missed"
+
+
+def test_ledger_forget_drops_ring_keeps_log(tmp_path):
+    path = str(tmp_path / "clients.jsonl")
+    led = ClientLedger(window=8, log_path=path)
+    led.observe("c1", "r0", "reported", train_s=0.1)
+    led.forget("c1")
+    assert led.known_clients() == []
+    assert open(path).read().strip()
+
+
+def test_record_round_outcomes_and_why_map():
+    led = ClientLedger(window=8)
+    resp = {"timings": {"train_s": 0.1}, "n_samples": 64,
+            "loss_history": [2.0, 1.0]}
+    # three healthy reporters build fleet history; w_slow reports a fat
+    # train_s; edge_x is in every cohort but never acks or reports (how
+    # an edge's own registry entry looks to the root ledger)
+    for rnd in range(3):
+        led.record_round(
+            f"r{rnd}",
+            cohort=["w0", "w1", "w2", "w_slow", "edge_x"],
+            participants=["w0", "w1", "w2", "w_slow"],
+            responses={"w0": resp, "w1": resp, "w2": resp,
+                       "w_slow": {"timings": {"train_s": 2.0}}},
+        )
+    # round 4: the slow worker refuses round_start (not a participant)
+    # and one healthy worker straggles
+    why = led.record_round(
+        "r3",
+        cohort=["w0", "w1", "w2", "w_slow", "edge_x"],
+        participants=["w0", "w1", "w2"],
+        responses={"w0": resp, "w1": resp},
+    )
+    # classification-backed reason for the known-slow client …
+    assert why["w_slow"].startswith("slow:"), why
+    # … first-straggle wording for the healthy participant …
+    assert why["w2"].startswith("healthy: first straggle"), why
+    # … and the inactive edge entry is NOT named every round
+    assert "edge_x" not in why, why
+    info = led.classify_all()
+    assert info["edge_x"]["status"] == "inactive"
+    assert info["w_slow"]["missed"] == 1
+
+
+def test_ledger_gauges_and_snapshot_cover_all_statuses():
+    led = ClientLedger(window=8)
+    for rnd in range(3):
+        led.record_round(
+            f"r{rnd}", ["a", "b", "c", "slowpoke", "ghost"],
+            ["a", "b", "c", "slowpoke"],
+            {"a": {"timings": {"train_s": 0.1}},
+             "b": {"timings": {"train_s": 0.1}},
+             "c": {"timings": {"train_s": 0.1}},
+             "slowpoke": {"timings": {"train_s": 3.0}}},
+        )
+    metrics = Metrics()
+    counts = led.export_gauges(metrics)
+    gauges = metrics.snapshot()["gauges"]
+    assert gauges["fleet_clients_total"] == 5
+    assert gauges["fleet_clients_slow"] == 1
+    assert gauges["fleet_clients_inactive"] == 1
+    assert sum(counts[s] for s in STATUSES) == 5
+    snap = led.health_snapshot()
+    assert snap["summary"]["total"] == 5
+    assert snap["clients"]["slowpoke"]["status"] == "slow"
+    assert set(snap["summary"]) == set(STATUSES) | {"total"}
+
+
+# ----------------------------------------------------------------------
+# history:* SLO derivation
+
+
+def test_derive_history_metrics_needs_two_snapshots():
+    assert derive_history_metrics(None) == {"history:samples": 0.0}
+    one = [{"ts": 1.0, "counters": {"x": 1}}]
+    assert derive_history_metrics(one) == {"history:samples": 1.0}
+
+
+def test_derive_history_metrics_deltas_and_rates():
+    hist = [  # deliberately out of order: must sort by ts
+        {"ts": 12.0, "counters": {"updates": 30, "weird": "nan?"}},
+        {"ts": 2.0, "counters": {"updates": 10}},
+        {"ts": 7.0, "counters": {"updates": 20}},
+    ]
+    m = derive_history_metrics(hist)
+    assert m["history:samples"] == 3.0
+    assert m["history:span_s"] == 10.0
+    assert m["history:delta:updates"] == 20.0
+    assert m["history:rate:updates"] == 2.0
+    assert "history:delta:weird" not in m
+
+
+# ----------------------------------------------------------------------
+# e2e: slow and flaky classification over a live 3-tier federation
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _wait_for(predicate, timeout_s=30.0, interval=0.05):
+    for _ in range(int(timeout_s / interval)):
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+async def _serve(app, port):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    return runner
+
+
+def test_fleet_health_e2e_slow_then_flaky(tmp_path):
+    async def main():
+        import aiohttp
+
+        name, dim, mport = "fleet", 10, _free_port()
+        model = linear_regression_model(dim)
+        mapp = web.Application()
+        exp = Manager(mapp).register_experiment(
+            model, name=name,
+            rounds_log_path=str(tmp_path / "rounds.jsonl"),
+            clients_log_path=str(tmp_path / "clients.jsonl"),
+            metrics_history_interval_s=0.5,
+        )
+        runners = [await _serve(mapp, mport)]
+        edges = []
+        for i in range(2):
+            eport = _free_port()
+            eapp = web.Application()
+            edges.append(EdgeAggregator(
+                eapp, f"127.0.0.1:{mport}", name=name, port=eport,
+                edge_name=f"e{i}", ship_settle_s=0.05,
+                heartbeat_time=5.0, metrics_history_interval_s=0.5,
+            ))
+            runners.append(await _serve(eapp, eport))
+
+        trainer = make_local_trainer(linear_regression_model(dim),
+                                     batch_size=32, learning_rate=0.02)
+        nprng = np.random.default_rng(7)
+        # worker 3 trains 8x slower AND carries a gated 503 on
+        # round_start — unavailability keeps its registration (hence
+        # its identity and history) while it misses rounds
+        gate = {"on": False}
+        workers = []
+        for i, scale in enumerate((1.0, 1.0, 1.0, 8.0)):
+            data = linear_client_data(nprng, min_batches=2,
+                                      max_batches=2)
+            inj = FaultInjector()
+            wapp = web.Application(middlewares=[inj.middleware])
+            if scale > 1.0:
+                inj.error("round_start", status=503,
+                          gate=lambda: gate["on"])
+            w = ExperimentWorker(
+                wapp, model, f"127.0.0.1:{mport}", name=name,
+                port=_free_port(), heartbeat_time=0.5,
+                trainer=trainer,
+                get_data=lambda d=data: (d, d["x"].shape[0]),
+                outbox_backoff=(0.05, 0.4), train_time_scale=scale,
+                edge=f"127.0.0.1:{edges[i % 2].port}",
+            )
+            workers.append(w)
+            runners.append(await _serve(wapp, w.port))
+        slow = workers[3]
+
+        async def round_once(session):
+            before = exp.rounds.n_rounds
+            async with session.get(
+                f"http://127.0.0.1:{mport}/{name}/start_round?n_epoch=1"
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+            assert await _wait_for(
+                lambda: exp.rounds.n_rounds > before, 60.0
+            ), "round did not complete"
+
+        try:
+            assert await _wait_for(lambda: len(exp.registry) == 6), \
+                "4 workers + 2 edges did not register"
+            async with aiohttp.ClientSession() as session:
+                base = f"http://127.0.0.1:{mport}/{name}"
+                # rounds 1-2: everyone reports; the 8x worker's
+                # self-reported train_s history marks it `slow`
+                for _ in range(2):
+                    await round_once(session)
+                async with session.get(f"{base}/fleet/health") as resp:
+                    assert resp.status == 200
+                    health = await resp.json()
+                sick = health["clients"][slow.client_id]
+                assert sick["status"] == "slow", sick
+                assert "robust z=" in sick["reason"], sick
+
+                # rounds 3-4: it 503s the notify. One miss is not yet
+                # flaky (the why-map explains it from the slow
+                # history); the second crosses FLAKY_MIN_MISSES
+                gate["on"] = True
+                await round_once(session)
+                with open(tmp_path / "rounds.jsonl") as fh:
+                    rec = [json.loads(ln) for ln in fh if ln.strip()][-1]
+                assert rec["straggler_why"][slow.client_id].startswith(
+                    "slow:"), rec
+                await round_once(session)
+                gate["on"] = False
+
+                async with session.get(f"{base}/fleet/health") as resp:
+                    flaky_health = await resp.json()
+                sick = flaky_health["clients"][slow.client_id]
+                assert sick["status"] == "flaky", sick
+                assert sick["missed"] + sick["straggled"] == 2, sick
+
+                # round 5: revived — it reports again under the SAME
+                # client id (503 never cost it its registration) and
+                # stays advisory-flagged, never evicted
+                await round_once(session)
+                async with session.get(f"{base}/fleet/health") as resp:
+                    revived = await resp.json()
+                sick = revived["clients"][slow.client_id]
+                assert sick["status"] == "flaky", sick
+                assert sick["last_outcome"] == "reported", sick
+                assert len(exp.registry) == 6
+
+                # the worker's local_train_s histogram carries a trace
+                # exemplar, and all three tiers answer the health plane
+                wt = slow.metrics.snapshot()["timers"]["local_train_s"]
+                assert wt.get("exemplar", {}).get("trace_id"), wt
+                for node in edges:
+                    eb = f"http://127.0.0.1:{node.port}/{name}"
+                    async with session.get(f"{eb}/fleet/health") as r:
+                        assert r.status == 200
+                        eh = await r.json()
+                    assert eh["summary"]["total"] >= 1, eh
+                    async with session.get(
+                        f"{eb}/metrics/history"
+                    ) as r:
+                        assert r.status == 200
+                        assert (await r.json())["samples"] >= 1
+        finally:
+            for r in runners:
+                await r.cleanup()
+
+    asyncio.run(main())

@@ -478,12 +478,14 @@ def test_byzantine_worker_defeated_by_median_aggregator():
         await web.TCPSite(mrunner, "127.0.0.1", mport).start()
 
         class ByzantineWorker(ExperimentWorker):
-            async def report_update(self, round_name, n_samples, loss_history):
+            async def report_update(self, round_name, n_samples,
+                                    loss_history, **kw):
                 # poison: scale trained weights by 1e6, claim huge weight
                 self.params = jax.tree_util.tree_map(
                     lambda a: a * 1e6, self.params
                 )
-                await super().report_update(round_name, 10_000, loss_history)
+                await super().report_update(round_name, 10_000,
+                                            loss_history, **kw)
 
         runners, workers = [mrunner], []
         shared = make_local_trainer(model, batch_size=32, learning_rate=0.02)

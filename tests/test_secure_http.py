@@ -207,7 +207,8 @@ class _SilentWorker(ExperimentWorker):
     """Completes key exchange and training but never uploads — the
     dropout case the recovery flow exists for."""
 
-    async def report_update(self, round_name, n_samples, loss_history):
+    async def report_update(self, round_name, n_samples, loss_history,
+                            **kw):
         return None
 
 
@@ -848,7 +849,8 @@ def test_stale_secure_finalization_never_touches_replacement_round():
                 # a NEW round starts while the thread still runs. Mute
                 # every worker first so round 2 cannot complete and the
                 # assertable end state is unambiguous.
-                async def _mute(round_name, n_samples, loss_history):
+                async def _mute(round_name, n_samples, loss_history,
+                                **kw):
                     return None
 
                 for w in workers:
