@@ -89,6 +89,24 @@ class LocalTrainer:
     def init_opt_state(self, params: Params):
         return self.optimizer.init(params)
 
+    # -- compute-plane accounting (baton_tpu/obs/compute.py) -----------
+    def train_signature(self, data: Batch, n_epochs: int) -> tuple:
+        """The jit-cache shape signature of one ``train`` call: data
+        shapes/dtypes plus the static epoch count. A signature the
+        compute probe's :class:`~baton_tpu.obs.compute.CompileTracker`
+        has not seen means XLA compiled during that call."""
+        shapes = tuple(sorted(
+            (k, tuple(v.shape), str(getattr(v, "dtype", type(v).__name__)))
+            for k, v in data.items()
+        ))
+        return (shapes, int(n_epochs), int(self.batch_size))
+
+    def steps_per_round(self, capacity: int, n_epochs: int) -> int:
+        """Optimizer steps one ``train`` call executes on device: the
+        scan runs every padded batch every epoch (masked no-ops included
+        — they still cost the FLOPs)."""
+        return int(n_epochs) * num_batches(int(capacity), self.batch_size)
+
     @partial(jax.jit, static_argnums=(0, 5))
     def train(
         self,

@@ -33,6 +33,17 @@ flat ``{metric_name: float}`` namespace:
     and per-second rates over the ring's wall-clock span. These are
     NOT absence-is-zero — a run that produced no history ring (or too
     few samples for a rate) fails the assertion, same rule as timers.
+``compute:*``
+    Derived from the ``compute`` section the manager folds into every
+    round record (obs/compute.py): ``rounds_with_compute``,
+    ``reporters_mean``, ``compile_s_max|mean``, ``steps_total``,
+    ``samples_per_sec_per_chip_mean``, ``mfu_mean``,
+    ``peak_hbm_gb_max``, ``recompile_storm_rounds``. A compute value
+    that is null *with a recorded reason* in every round (CPU smoke has
+    no MFU) becomes a ``skips`` entry instead of a metric — the
+    baseline gate reports it ``skipped`` rather than regressed, exactly
+    the bench carve-out; a null with NO reason is simply absent and
+    regresses.
 
 A *counter* address that the run never touched resolves to 0 — a
 counter is born at its first ``inc``, so absence IS zero
@@ -322,6 +333,58 @@ def check_baseline(
     return results
 
 
+def derive_compute_metrics(
+    records: List[dict],
+) -> "tuple[Dict[str, float], Dict[str, str]]":
+    """``compute:*`` metrics from completed rounds' ``compute``
+    sections. Returns ``(metrics, skips)`` with the null-with-reason
+    carve-out (module docstring): a value unmeasured in every round but
+    excused in each lands in ``skips``; one that simply vanished stays
+    absent and the baseline gate regresses it."""
+    metrics: Dict[str, float] = {}
+    skips: Dict[str, str] = {}
+    sections = [
+        r["compute"] for r in records
+        if r.get("outcome") == "completed" and isinstance(r.get("compute"), dict)
+    ]
+    if not sections:
+        return metrics, skips
+    with_compute = [s for s in sections if s.get("reporters")]
+    metrics["compute:rounds_with_compute"] = float(len(with_compute))
+    metrics["compute:reporters_mean"] = sum(
+        float(s.get("reporters") or 0) for s in sections
+    ) / len(sections)
+
+    def fold(key: str, out: str, agg) -> None:
+        vals = [
+            float(s[key]) for s in sections
+            if isinstance(s.get(key), (int, float))
+            and not isinstance(s.get(key), bool)
+        ]
+        if vals:
+            metrics[out] = agg(vals)
+            return
+        for s in sections:
+            why = s.get(f"{key}_reason") or s.get(f"{key}_source")
+            if isinstance(why, str) and why:
+                skips[out] = why
+                return
+
+    fold("compile_s", "compute:compile_s_max", max)
+    fold("compile_s", "compute:compile_s_mean",
+         lambda v: sum(v) / len(v))
+    fold("steps", "compute:steps_total", sum)
+    fold("samples_per_sec_per_chip",
+         "compute:samples_per_sec_per_chip_mean",
+         lambda v: sum(v) / len(v))
+    fold("mfu", "compute:mfu_mean", lambda v: sum(v) / len(v))
+    fold("peak_hbm_gb", "compute:peak_hbm_gb_max", max)
+    metrics["compute:recompile_storm_rounds"] = float(sum(
+        1 for s in sections if s.get("recompile_storms")
+    ))
+    return metrics, skips
+
+
 def derive_bench_metrics(parsed: dict) -> "tuple[Dict[str, float], Dict[str, str]]":
     """Flatten one ``bench.py`` output record into the flat SLO
     namespace under a ``bench:`` prefix, so :func:`check_baseline` can
@@ -409,6 +472,8 @@ def evaluate_slo(
                              fleet_snapshot, edge_snapshot)
     if history is not None:
         metrics.update(derive_history_metrics(history))
+    compute_metrics, compute_skips = derive_compute_metrics(kept)
+    metrics.update(compute_metrics)
     assertions = check_assertions(slo.assertions, metrics)
 
     baseline_block = None
@@ -416,6 +481,14 @@ def evaluate_slo(
         baseline = load_baseline(slo.baseline)
     if baseline is not None:
         results = check_baseline(baseline, metrics)
+        for entry in results:
+            reason = compute_skips.get(entry["metric"])
+            if entry["regression"] and entry["observed"] is None and reason:
+                # same carve-out as check_bench_baseline: unmeasured
+                # WITH a recorded reason is a visible skip, not a
+                # silent regression
+                entry["regression"] = False
+                entry["note"] = f"skipped: {reason}"
         baseline_block = {
             "path": slo.baseline,
             "results": results,
@@ -433,6 +506,7 @@ def evaluate_slo(
         "torn_lines": n_torn,
         "assertions": assertions,
         "baseline": baseline_block,
+        "compute_skips": compute_skips,
         "metrics": metrics,
     }
 

@@ -1,0 +1,7 @@
+"""Compute-plane observability: live MFU / compile / HBM telemetry.
+
+``baton_tpu.obs.compute`` is the shared probe behind bench.py's offline
+numbers AND the live round loop's per-round compute records (worker →
+edge → manager → ``rounds.jsonl`` → fleet ledger → SLO gate → ops
+console).
+"""

@@ -1,0 +1,501 @@
+"""Compute-plane probe: analytic FLOPs/MFU accounting, jit compile
+tracking, and peak-HBM reading for the LIVE training path.
+
+Until this module existed, MFU / compile seconds / peak HBM were
+measured only inside offline ``bench.py`` runs — the round loop itself
+was blind on the compute plane. The probe instruments every local
+training call (worker ``_run_round``, the manager's simulated cohort via
+``parallel/engine.py``) and emits one *compute record* per round, which
+rides the update metadata to the root, lands in the round's
+``rounds.jsonl`` SLO record (``compute`` section), feeds the per-client
+fleet ledger, and gates ``compute:*`` SLO metrics in CI.
+
+Three design rules, each a recorded postmortem:
+
+* **One FLOPs implementation.** The per-model analytic FLOPs constants
+  and the MFU formula live HERE; ``bench.py`` imports them. Bench MFU
+  and live MFU can no longer diverge (they were duplicated before).
+* **Null-with-reason.** Every ``None`` metric in a compute record
+  carries a sibling ``<name>_reason`` / ``<name>_source`` string
+  (:func:`validate_record` enforces it). The BENCH_r04 lesson: a silent
+  null reads as "stopped measuring" and hides regressions.
+* **Compile visibility.** :class:`CompileTracker` watches the shape
+  signatures each jitted callable is invoked with: a new signature is a
+  cache miss (XLA compiled during that call), and repeated new
+  signatures within a short window are a *recompile storm* — the
+  shape-churn pathology that silently multiplies round latency.
+
+``compile_s`` on a cache miss is the compiling call's wall time — an
+upper bound that includes one execution (the live path cannot afford a
+separate warm-up run; ``compile_s_source`` says so). On a cache hit it is
+an exact 0.0.
+
+Pure stdlib + optional lazy jax: the FLOPs/MFU math and the tracker
+import and unit-test without an accelerator stack.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "RESNET18_CIFAR_FWD_FLOPS_PER_IMG",
+    "TRAIN_FLOPS_PER_IMG",
+    "TPU_PEAK_FLOPS",
+    "MODEL_FAMILY_FLOPS",
+    "register_model_flops",
+    "model_family_of",
+    "train_flops_per_sample",
+    "peak_flops_for",
+    "compute_mfu",
+    "CompileTracker",
+    "ComputeProbe",
+    "build_record",
+    "validate_record",
+    "summarize_round",
+    "RECOMPILE_STORM_THRESHOLD",
+    "RECOMPILE_STORM_WINDOW",
+]
+
+# ---------------------------------------------------------------------------
+# Analytic FLOPs accounting (extracted from bench.py — the ONE copy).
+#
+# ResNet-18 (CIFAR-10 variant, 32x32 input): 0.557 GMAC forward per
+# image = 1.11 GFLOP (x2 MAC->FLOP); training ~3x forward (fwd + 2x
+# bwd).
+RESNET18_CIFAR_FWD_FLOPS_PER_IMG = 1.11e9
+TRAIN_FLOPS_PER_IMG = 3.0 * RESNET18_CIFAR_FWD_FLOPS_PER_IMG
+
+# Peak dense-matmul throughput by device kind (bf16, FLOP/s) — the MFU
+# denominator. Source: public TPU spec sheets. Prefix-matched against
+# ``device.device_kind`` (platform strings vary: "TPU v5 lite" on the
+# axon tunnel, "TPU v5e" in docs).
+TPU_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # Trillium / v6e
+    "TPU v6e": 918e12,
+}
+
+#: analytic *training* FLOPs per sample, by model family
+MODEL_FAMILY_FLOPS: Dict[str, float] = {
+    "resnet18_cifar": TRAIN_FLOPS_PER_IMG,
+}
+
+# model-name prefix -> family key in MODEL_FAMILY_FLOPS (FedModel.name
+# is free-form; bench's model is named "resnet18*")
+_FAMILY_PREFIXES: List[Tuple[str, str]] = [
+    ("resnet18", "resnet18_cifar"),
+]
+
+
+def register_model_flops(
+    family: str,
+    train_flops_per_sample: float,
+    name_prefixes: Sequence[str] = (),
+) -> None:
+    """Register a model family's analytic training FLOPs per sample so
+    live rounds on that family get measured MFU. ``name_prefixes`` maps
+    ``FedModel.name`` values to the family."""
+    if not (train_flops_per_sample > 0):
+        raise ValueError("train_flops_per_sample must be > 0")
+    MODEL_FAMILY_FLOPS[family] = float(train_flops_per_sample)
+    for p in name_prefixes:
+        _FAMILY_PREFIXES.append((str(p), family))
+
+
+def model_family_of(model: Any) -> Tuple[Optional[str], Optional[str]]:
+    """``(family, reason)`` for a model (a :class:`FedModel`, anything
+    with a ``name``, or a bare name string). ``family`` is a key of
+    :data:`MODEL_FAMILY_FLOPS`; unknown models return
+    ``(None, reason)`` — an unknown family is *expected* (linear smoke
+    models, custom nets) and downstream MFU is null-with-reason."""
+    name = model if isinstance(model, str) else getattr(model, "name", None)
+    if not name:
+        return None, "model has no name attribute"
+    for prefix, family in _FAMILY_PREFIXES:
+        if name.startswith(prefix):
+            return family, None
+    return None, f"no FLOPs accounting registered for model {name!r}"
+
+
+def train_flops_per_sample(
+    family: Optional[str],
+) -> Tuple[Optional[float], Optional[str]]:
+    """Analytic training FLOPs per sample for ``family``, or
+    ``(None, reason)``."""
+    if family is None:
+        return None, "model family unknown"
+    flops = MODEL_FAMILY_FLOPS.get(family)
+    if flops is None:
+        return None, f"no FLOPs accounting for family {family!r}"
+    return flops, None
+
+
+def peak_flops_for(
+    device_kind: str,
+) -> Tuple[Optional[float], Optional[str]]:
+    """Chip peak FLOP/s for a ``device_kind`` string (prefix-matched),
+    or ``(None, reason)`` — CPU smoke runs have no meaningful peak."""
+    for prefix, peak in TPU_PEAK_FLOPS.items():
+        if device_kind.startswith(prefix):
+            return peak, None
+    return None, f"no peak-FLOPs spec for device kind {device_kind!r}"
+
+
+def compute_mfu(
+    samples_per_sec_per_chip: Optional[float],
+    flops_per_sample: Optional[float],
+    device_kind: str,
+) -> Tuple[Optional[float], Optional[str]]:
+    """MFU = delivered analytic training FLOPs / chip peak — the exact
+    formula bench.py's headline uses. ``(None, reason)`` when any input
+    is unavailable."""
+    if samples_per_sec_per_chip is None:
+        return None, "throughput unmeasured"
+    if flops_per_sample is None:
+        return None, "model FLOPs unavailable"
+    peak, why = peak_flops_for(device_kind)
+    if peak is None:
+        return None, why
+    return samples_per_sec_per_chip * flops_per_sample / peak, None
+
+
+# ---------------------------------------------------------------------------
+# Compile tracking
+
+#: new shape signatures within the window that flag a recompile storm —
+#: one compile per new (cohort, epochs) shape is expected; three in a
+#: window of eight rounds means the shapes are churning
+RECOMPILE_STORM_THRESHOLD = 3
+RECOMPILE_STORM_WINDOW = 8
+
+
+class CompileTracker:
+    """Shape-signature watcher for jitted callables.
+
+    The live path cannot see inside XLA's jit cache, but it controls the
+    cache key: a call with a signature this tracker has not seen for
+    ``key`` compiled during that call. ``observe`` returns the compile
+    fields of the round's compute record.
+    """
+
+    def __init__(
+        self,
+        storm_window: int = RECOMPILE_STORM_WINDOW,
+        storm_threshold: int = RECOMPILE_STORM_THRESHOLD,
+    ) -> None:
+        self.storm_window = max(2, int(storm_window))
+        self.storm_threshold = max(2, int(storm_threshold))
+        self._sigs: Dict[Any, set] = {}
+        self._recent: Dict[Any, deque] = {}
+
+    def observe(
+        self,
+        key: Any,
+        signature: Any,
+        wall_s: Optional[float] = None,
+    ) -> dict:
+        """Record one invocation of callable ``key`` with shape
+        ``signature``; ``wall_s`` is that call's wall time (the
+        compile_s upper bound on a miss)."""
+        sigs = self._sigs.setdefault(key, set())
+        miss = signature not in sigs
+        if miss:
+            sigs.add(signature)
+        recent = self._recent.setdefault(
+            key, deque(maxlen=self.storm_window)
+        )
+        recent.append(miss)
+        out: dict = {
+            "cache_hit": not miss,
+            "recompiles": max(0, len(sigs) - 1),
+            "recompile_storm": sum(recent) >= self.storm_threshold,
+        }
+        if not miss:
+            out["compile_s"] = 0.0
+            out["compile_s_source"] = "cache_hit"
+        elif wall_s is not None:
+            out["compile_s"] = float(wall_s)
+            out["compile_s_source"] = "first_call_wall"
+        else:
+            out["compile_s"] = None
+            out["compile_s_reason"] = "wall time unavailable for compiling call"
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Record building + the null-with-reason invariant
+
+def validate_record(record: dict) -> List[str]:
+    """The null-with-reason invariant: every ``None`` value must have a
+    non-empty ``<key>_reason`` or ``<key>_source`` sibling string.
+    Returns the violations (empty = valid)."""
+    bad = []
+    for key, val in record.items():
+        if val is not None:
+            continue
+        if key.endswith(("_reason", "_source")):
+            bad.append(f"{key}: reason/source field itself is null")
+            continue
+        excuse = record.get(f"{key}_reason") or record.get(f"{key}_source")
+        if not (isinstance(excuse, str) and excuse):
+            bad.append(f"{key}: null without {key}_reason/{key}_source")
+    return bad
+
+
+def build_record(
+    *,
+    train_s: float,
+    n_samples: float,
+    n_epochs: int = 1,
+    steps: Optional[int] = None,
+    device_kind: str = "unknown",
+    n_chips: int = 1,
+    model_family: Optional[str] = None,
+    model_family_reason: Optional[str] = None,
+    compile_fields: Optional[dict] = None,
+    peak_hbm_gb: Optional[float] = None,
+    peak_hbm_source: Optional[str] = None,
+    peak_hbm_reason: Optional[str] = None,
+) -> dict:
+    """Assemble one round's compute record, deriving throughput and MFU
+    and enforcing the null-with-reason invariant by construction."""
+    train_s = float(train_s)
+    n_chips = max(1, int(n_chips))
+    rec: dict = {
+        "train_s": round(train_s, 6),
+        "steps": int(steps) if steps is not None else int(max(1, n_epochs)),
+        "n_chips": n_chips,
+        "device_kind": device_kind,
+    }
+    if model_family is not None:
+        rec["model_family"] = model_family
+    else:
+        rec["model_family"] = None
+        rec["model_family_reason"] = (
+            model_family_reason or "model family unknown"
+        )
+    if train_s > 0 and n_samples > 0:
+        sps = float(n_samples) * max(1, int(n_epochs)) / train_s
+        rec["samples_per_sec"] = round(sps, 3)
+        rec["samples_per_sec_per_chip"] = round(sps / n_chips, 3)
+    else:
+        why = "zero training wall time" if n_samples > 0 else "no samples"
+        rec["samples_per_sec"] = None
+        rec["samples_per_sec_reason"] = why
+        rec["samples_per_sec_per_chip"] = None
+        rec["samples_per_sec_per_chip_reason"] = why
+    flops, flops_why = train_flops_per_sample(rec.get("model_family"))
+    if flops is not None:
+        rec["flops_per_sample"] = flops
+    else:
+        rec["flops_per_sample"] = None
+        rec["flops_per_sample_reason"] = flops_why or "model FLOPs unavailable"
+    mfu, mfu_why = compute_mfu(
+        rec.get("samples_per_sec_per_chip"), flops, device_kind
+    )
+    if mfu is not None:
+        rec["mfu"] = round(mfu, 6)
+    else:
+        rec["mfu"] = None
+        rec["mfu_reason"] = mfu_why or "mfu unavailable"
+    rec.update(compile_fields or {
+        "compile_s": None,
+        "compile_s_reason": "compile tracking not wired for this path",
+    })
+    if peak_hbm_gb is not None:
+        rec["peak_hbm_gb"] = round(float(peak_hbm_gb), 6)
+        rec["peak_hbm_gb_source"] = peak_hbm_source or "unspecified"
+    else:
+        rec["peak_hbm_gb"] = None
+        rec["peak_hbm_gb_reason"] = (
+            peak_hbm_reason or "no allocator stats or memory plan available"
+        )
+    violations = validate_record(rec)
+    if violations:  # by-construction guard; unreachable via this builder
+        raise ValueError(f"compute record breaks null-with-reason: "
+                         f"{violations}")
+    return rec
+
+
+class ComputeProbe:
+    """Per-process probe instrumenting one training call site.
+
+    One probe per worker / engine; :meth:`record_round` is called once
+    per round with that round's wall time + shape signature and returns
+    the compute record (compile fields via the shared tracker, HBM via
+    the runtime allocator falling back to reasons)."""
+
+    def __init__(
+        self,
+        model: Any = None,
+        model_family: Optional[str] = None,
+        storm_window: int = RECOMPILE_STORM_WINDOW,
+        storm_threshold: int = RECOMPILE_STORM_THRESHOLD,
+    ) -> None:
+        if model_family is not None:
+            self.model_family: Optional[str] = model_family
+            self.model_family_reason: Optional[str] = None
+        else:
+            self.model_family, self.model_family_reason = (
+                model_family_of(model) if model is not None
+                else (None, "no model attached to probe")
+            )
+        self.tracker = CompileTracker(storm_window, storm_threshold)
+        # device topology is fixed for the life of the process; cache the
+        # lookups so record_round stays off the jax client per round
+        self._cached_device: Any = None
+        self._cached_n_chips: Optional[int] = None
+
+    @staticmethod
+    def _device():
+        try:
+            import jax
+
+            return jax.devices()[0]
+        except Exception:
+            return None
+
+    @staticmethod
+    def _peak_hbm(device) -> Tuple[Optional[float], Optional[str],
+                                   Optional[str]]:
+        """(gb, source, reason) — allocator stats preferred, then the
+        shared :func:`baton_tpu.utils.profiling.peak_hbm_gb` plan-space
+        path (a no-op without a jitted program), then a reason."""
+        if device is None:
+            return None, None, "no jax device available"
+        try:
+            from baton_tpu.utils.profiling import peak_hbm_gb
+
+            gb, src = peak_hbm_gb(device)
+        except Exception as exc:
+            return None, None, f"hbm probe failed: {type(exc).__name__}"
+        if gb is not None:
+            return gb, src, None
+        plat = getattr(device, "platform", "unknown")
+        return None, None, (
+            f"runtime surfaces no allocator stats on platform {plat!r}"
+        )
+
+    def record_round(
+        self,
+        *,
+        key: Any,
+        signature: Any,
+        train_s: float,
+        n_samples: float,
+        n_epochs: int = 1,
+        steps: Optional[int] = None,
+        device: Any = None,
+        n_chips: Optional[int] = None,
+    ) -> dict:
+        if device is not None:
+            dev = device
+        else:
+            if self._cached_device is None:
+                self._cached_device = self._device()
+            dev = self._cached_device
+        device_kind = getattr(
+            dev, "device_kind", getattr(dev, "platform", "unknown")
+        ) if dev is not None else "unknown"
+        if n_chips is None:
+            if self._cached_n_chips is None:
+                try:
+                    import jax
+
+                    self._cached_n_chips = jax.device_count()
+                except Exception:
+                    self._cached_n_chips = 1
+            n_chips = self._cached_n_chips
+        compile_fields = self.tracker.observe(key, signature, wall_s=train_s)
+        hbm_gb, hbm_src, hbm_why = self._peak_hbm(dev)
+        return build_record(
+            train_s=train_s,
+            n_samples=n_samples,
+            n_epochs=n_epochs,
+            steps=steps,
+            device_kind=str(device_kind),
+            n_chips=int(n_chips),
+            model_family=self.model_family,
+            model_family_reason=self.model_family_reason,
+            compile_fields=compile_fields,
+            peak_hbm_gb=hbm_gb,
+            peak_hbm_source=hbm_src,
+            peak_hbm_reason=hbm_why,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Round-level aggregation (the rounds.jsonl ``compute`` section)
+
+def _nums(records: Sequence[dict], key: str) -> List[float]:
+    return [
+        float(r[key]) for r in records
+        if isinstance(r.get(key), (int, float))
+        and not isinstance(r.get(key), bool)
+        and math.isfinite(float(r[key]))
+    ]
+
+
+def _first_reason(records: Sequence[dict], key: str, default: str) -> str:
+    for r in records:
+        why = r.get(f"{key}_reason") or r.get(f"{key}_source")
+        if isinstance(why, str) and why:
+            return why
+    return default
+
+
+def summarize_round(records: Sequence[dict]) -> dict:
+    """Fold the reporters' per-client compute records into one round
+    ``compute`` section. Aggregates keep the null-with-reason rule: a
+    value no reporter measured is null with the first reporter's reason
+    (or an explicit "no compute records")."""
+    records = [r for r in records if isinstance(r, dict)]
+    out: dict = {"reporters": len(records)}
+    if not records:
+        for key in ("compile_s", "steps", "samples_per_sec_per_chip",
+                    "mfu", "peak_hbm_gb"):
+            out[key] = None
+            out[f"{key}_reason"] = "no compute records this round"
+        out["recompile_storms"] = 0
+        return out
+
+    def put(key: str, vals: List[float], agg) -> None:
+        if vals:
+            out[key] = round(agg(vals), 6)
+        else:
+            out[key] = None
+            out[f"{key}_reason"] = _first_reason(
+                records, key, f"no reporter measured {key}"
+            )
+
+    put("compile_s", _nums(records, "compile_s"), max)
+    steps = _nums(records, "steps")
+    out["steps"] = int(sum(steps)) if steps else None
+    if not steps:
+        out["steps_reason"] = "no reporter measured steps"
+    put("samples_per_sec_per_chip",
+        _nums(records, "samples_per_sec_per_chip"),
+        lambda v: sum(v) / len(v))
+    put("mfu", _nums(records, "mfu"), lambda v: sum(v) / len(v))
+    hbm = _nums(records, "peak_hbm_gb")
+    if hbm:
+        out["peak_hbm_gb"] = round(max(hbm), 6)
+        out["peak_hbm_gb_source"] = _first_reason(
+            records, "peak_hbm_gb", "allocator"
+        )
+    else:
+        out["peak_hbm_gb"] = None
+        out["peak_hbm_gb_reason"] = _first_reason(
+            records, "peak_hbm_gb", "no reporter measured peak HBM"
+        )
+    out["recompile_storms"] = sum(
+        1 for r in records if r.get("recompile_storm")
+    )
+    return out

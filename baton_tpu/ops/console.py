@@ -3,9 +3,15 @@
 Polls the root manager and any number of edges over plain HTTP —
 ``GET …/metrics``, ``GET …/fleet/health`` — plus (optionally) the
 manager's ``rounds.jsonl``, and renders a top-like terminal view:
-round throughput, per-tier phase counters, and every known client with
-its fleet-health classification (healthy / slow / flaky / degrading /
-inactive) and the reason string the anomaly scorer produced.
+round throughput, per-tier phase counters, the compute plane (per-node
+MFU, samples/sec/chip, peak HBM, recompile-storm flag from the
+``compute_*`` gauges), and every known client with its fleet-health
+classification (healthy / slow / flaky / degrading / inactive) and the
+reason string the anomaly scorer produced.
+
+Live mode polls metrics history as a DELTA: each refresh passes the
+previous poll's ``ts`` as ``/metrics/history?since=<ts>`` so only new
+samples cross the wire, never the full ring.
 
 Two modes:
 
@@ -54,18 +60,30 @@ def fetch_json(url: str, timeout_s: float = 3.0) -> Optional[dict]:
         return None
 
 
-def poll_node(base_url: str, timeout_s: float = 3.0) -> dict:
+def poll_node(
+    base_url: str,
+    timeout_s: float = 3.0,
+    history_since: Optional[float] = None,
+) -> dict:
     """One node's ``/metrics`` + ``/fleet/health``, tagged with
-    reachability (``up``) so the renderer can show dead tiers."""
+    reachability (``up``) so the renderer can show dead tiers.
+    ``history_since`` additionally fetches the metrics-history DELTA
+    (``/metrics/history?since=<ts>``) — only samples newer than the
+    previous poll, never the full ring."""
     base = base_url.rstrip("/")
     metrics = fetch_json(f"{base}/metrics", timeout_s)
     health = fetch_json(f"{base}/fleet/health", timeout_s)
-    return {
+    out = {
         "url": base,
         "up": metrics is not None,
         "metrics": metrics,
         "health": health,
     }
+    if history_since is not None:
+        out["history"] = fetch_json(
+            f"{base}/metrics/history?since={history_since:.6f}", timeout_s
+        )
+    return out
 
 
 def _tail_rounds(path: Optional[str], n: int = 5) -> List[dict]:
@@ -90,13 +108,15 @@ def poll_fleet(
     edges: List[str],
     rounds_path: Optional[str] = None,
     timeout_s: float = 3.0,
+    history_since: Optional[float] = None,
 ) -> dict:
     """The full console state for one poll — also the ``--json``
     payload, so the interactive view and the CI probe can never
-    drift apart."""
+    drift apart. ``history_since`` (the previous poll's ``ts``) makes
+    the root poll fetch only new metrics-history samples."""
     return {
         "ts": round(time.time(), 3),
-        "root": poll_node(root, timeout_s),
+        "root": poll_node(root, timeout_s, history_since=history_since),
         "edges": [poll_node(e, timeout_s) for e in edges],
         "rounds_tail": _tail_rounds(rounds_path),
     }
@@ -112,6 +132,42 @@ def _fmt_s(v: Any) -> str:
 def _counter(node: dict, name: str) -> float:
     m = node.get("metrics") or {}
     return float((m.get("counters") or {}).get(name, 0.0))
+
+
+def _gauge(node: dict, name: str) -> Optional[float]:
+    m = node.get("metrics") or {}
+    v = (m.get("gauges") or {}).get(name)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _fmt_num(v: Any, fmt: str = "{:.3f}") -> str:
+    if isinstance(v, (int, float)):
+        return fmt.format(v)
+    return "--"
+
+
+def _compute_line(node: dict, label: str) -> Optional[str]:
+    """The per-node compute pane row: last-round MFU / throughput /
+    HBM gauges plus the recompile-storm flag. None when the node has
+    never published a compute gauge (pre-compute managers stay
+    renderable)."""
+    mfu = _gauge(node, "compute_mfu")
+    sps = _gauge(node, "compute_samples_per_sec_per_chip")
+    hbm = _gauge(node, "compute_peak_hbm_gb")
+    steps = _gauge(node, "compute_steps")
+    reporters = _gauge(node, "compute_reporters")
+    storm = _gauge(node, "compute_recompile_storm")
+    if all(v is None for v in (mfu, sps, hbm, steps, reporters, storm)):
+        return None
+    storm_s = "STORM" if storm else "no"
+    return (
+        f"  compute[{label}]: mfu={_fmt_num(mfu)}  "
+        f"sps/chip={_fmt_num(sps, '{:.1f}')}  "
+        f"hbm={_fmt_num(hbm, '{:.2f}')}GiB  "
+        f"steps={_fmt_num(steps, '{:.0f}')}  "
+        f"reporters={_fmt_num(reporters, '{:.0f}')}  "
+        f"recompile-storm={storm_s}"
+    )
 
 
 def _client_rows(health: Optional[dict], via: str) -> List[tuple]:
@@ -159,6 +215,20 @@ def render(state: dict, color: bool = True) -> str:
                      f"shipped={_counter(e, 'edge_partials_shipped'):.0f}  "
                      f"{phases}")
 
+    compute_rows = [_compute_line(root, "root")]
+    for e in state["edges"]:
+        node = ((e.get("health") or {}).get("node")) or e["url"]
+        compute_rows.append(_compute_line(e, node))
+    compute_rows = [r for r in compute_rows if r]
+    if compute_rows:
+        storming = any("STORM" in r for r in compute_rows)
+        lines.extend(paint("slow", r) if ("STORM" in r and color) else r
+                     for r in compute_rows)
+        if storming:
+            lines.append(paint("slow", "  !! recompile storm in the "
+                                       "last round — check input "
+                                       "shape churn"))
+
     summary = ((root.get("health") or {}).get("summary")) or {}
     if summary:
         lines.append(
@@ -195,11 +265,22 @@ def render(state: dict, color: bool = True) -> str:
             why_s = ("  why: " + "; ".join(
                 f"{c}: {w}" for c, w in sorted(why.items())
             )) if why else ""
+            comp = r.get("compute") or {}
+            comp_s = ""
+            if isinstance(comp, dict) and comp:
+                comp_s = (
+                    f"  mfu={_fmt_num(comp.get('mfu'))}"
+                    f" sps/chip="
+                    f"{_fmt_num(comp.get('samples_per_sec_per_chip'), '{:.1f}')}"
+                    f" compile={_fmt_num(comp.get('compile_s'))}s"
+                )
+                if comp.get("recompile_storms"):
+                    comp_s += f" storms={comp['recompile_storms']}"
             lines.append(
                 f"    {r.get('round')}: {r.get('outcome')} "
                 f"{float(r.get('duration_s') or 0.0):.2f}s "
                 f"reporters={r.get('reporters')}"
-                f"/{r.get('participants')}{why_s}"
+                f"/{r.get('participants')}{why_s}{comp_s}"
             )
     return "\n".join(lines)
 
@@ -229,8 +310,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     edges = [e.strip() for e in args.edges.split(",") if e.strip()]
+    last_ts: Optional[float] = None
     while True:
-        state = poll_fleet(args.root, edges, args.rounds, args.timeout)
+        state = poll_fleet(args.root, edges, args.rounds, args.timeout,
+                           history_since=last_ts)
+        last_ts = state["ts"]
         all_up = state["root"]["up"] and all(
             e["up"] for e in state["edges"]
         )

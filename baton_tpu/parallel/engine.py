@@ -29,6 +29,7 @@ case (replaces the in-place assignment at reference manager.py:123-126).
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Dict, Optional
 
@@ -41,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from baton_tpu.core.model import FedModel
 from baton_tpu.core.partition import PathPredicate, make_partition
 from baton_tpu.core.training import LocalTrainer, make_local_trainer, make_evaluator
+from baton_tpu.obs.compute import ComputeProbe
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.ops.padding import round_up
 from baton_tpu.parallel.compat import shard_map
@@ -143,6 +145,12 @@ class FedSim:
         # params seen (structure unknown until then).
         self.trainable_predicate = trainable
         self.partition = None
+        # compute-plane probe: run_round leaves its per-round compute
+        # record (MFU/compile/HBM, null-with-reason) in ``last_compute``
+        # for the caller (the manager's simulated-cohort path ships it
+        # into the round's SLO record). Costs one scalar sync per round.
+        self.compute_probe = ComputeProbe(model=model)
+        self.last_compute: Optional[dict] = None
 
     def _ensure_partition(self, params):
         if self.trainable_predicate is None or self.partition is not None:
@@ -512,6 +520,7 @@ class FedSim:
         w_acc = None
         stacked_parts = [] if robust else None
         per_client = [] if collect_client_losses else None
+        t_waves0 = time.perf_counter()
         for start in range(0, c, wave_size):
             stop = min(start + wave_size, c)
             d = jax.tree_util.tree_map(lambda a: a[start:stop], data)
@@ -547,6 +556,32 @@ class FedSim:
             if progress_fn is not None:
                 jax.block_until_ready(lsum)
                 progress_fn(start // wave_size + 1, -(-c // wave_size))
+
+        # --- compute record (obs/compute.py) ------------------------------
+        # One scalar sync on the loss sum closes the timed window over
+        # the wave loop (compile included on a cache miss — the tracker's
+        # shape signature says whether this shape compiled). Guarded: a
+        # probe failure must never fail training.
+        try:
+            jax.block_until_ready(lsum_acc)
+            train_s = time.perf_counter() - t_waves0
+            capacity = next(
+                (int(a.shape[1]) for a in data.values()
+                 if getattr(a, "ndim", 0) >= 2), 1)
+            bsz = max(1, int(self.trainer.batch_size))
+            sig = (c, int(wave_size), int(n_epochs), robust,
+                   tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                                for k, v in data.items())))
+            self.last_compute = self.compute_probe.record_round(
+                key="run_round",
+                signature=sig,
+                train_s=train_s,
+                n_samples=float(np.asarray(n_samples).sum()),
+                n_epochs=n_epochs,
+                steps=c * n_epochs * -(-capacity // bsz),
+            )
+        except Exception:
+            self.last_compute = None
 
         denom = jnp.maximum(w_acc, 1e-9)
         if robust:

@@ -735,6 +735,7 @@ class EdgeAggregator:
                     "loss_history": c.get("loss_history"),
                     "upload_bytes": c.get("bytes"),
                     "timings": c.get("timings"),
+                    "compute": c.get("compute"),
                 }
                 for cid, c in r.contributors.items()
             }
@@ -876,6 +877,11 @@ class EdgeAggregator:
             # worker self-reported wall times, shipped upstream in the
             # partial's contributor set (the root sanitizes values)
             entry["timings"] = timings
+        compute = meta.get("compute")
+        if isinstance(compute, dict):
+            # per-round compute record (obs/compute.py) — same contract
+            # as timings: pass through verbatim, the root sanitizes
+            entry["compute"] = compute
         r.contributors[client_id] = entry
         r.pending_folds += 1
         self.metrics.inc("edge_updates_folded")

@@ -72,6 +72,11 @@ DEGRADE_RATIO = 1.5
 DEGRADE_MIN_OBS = 6
 #: absolute train-time increase (s) below which trends are noise
 DEGRADE_MIN_DELTA_S = 0.01
+#: recent/older MFU ratio below which a client is losing compute
+#: efficiency (the inverse direction of DEGRADE_RATIO: MFU falls)
+MFU_DEGRADE_RATIO = 1.0 / 1.5
+#: absolute MFU drop below which MFU trends are noise
+MFU_DEGRADE_MIN_DELTA = 0.01
 # 1.4826 scales MAD to σ for normal data; the floor keeps an outlier
 # detectable when the rest of the fleet is perfectly uniform (MAD = 0)
 _MAD_SIGMA = 1.4826
@@ -165,6 +170,24 @@ def classify_client(
                 f"last {len(trains)} reports"
             )
 
+    # degrading (compute plane): own MFU trending DOWN — a client whose
+    # wall time holds steady while its delivered FLOPs collapse (e.g.
+    # a recompile storm, thermal throttling) would otherwise pass every
+    # wall-clock check above
+    mfus = [o["mfu"] for o in reported if o.get("mfu") is not None]
+    if len(mfus) >= DEGRADE_MIN_OBS:
+        half = len(mfus) // 2
+        older, recent = _median(mfus[:half]), _median(mfus[half:])
+        if (
+            older is not None and recent is not None
+            and recent <= MFU_DEGRADE_RATIO * older
+            and older - recent >= MFU_DEGRADE_MIN_DELTA
+        ):
+            return "degrading", (
+                f"mfu median {older:.3f} -> {recent:.3f} over "
+                f"last {len(mfus)} reports"
+            )
+
     return "healthy", ""
 
 
@@ -209,6 +232,9 @@ class ClientLedger:
         hb_rtt_s: Optional[float] = None,
         n_samples: Optional[float] = None,
         via_edge: Optional[str] = None,
+        mfu: Optional[float] = None,
+        compile_s: Optional[float] = None,
+        recompile_storm: Optional[bool] = None,
         ts: Optional[float] = None,
     ) -> dict:
         """Record one per-round observation for ``client_id``."""
@@ -235,6 +261,12 @@ class ClientLedger:
             entry["n_samples"] = float(n_samples)
         if via_edge is not None:
             entry["via_edge"] = via_edge
+        if mfu is not None:
+            entry["mfu"] = round(float(mfu), 6)
+        if compile_s is not None:
+            entry["compile_s"] = round(float(compile_s), 6)
+        if recompile_storm:
+            entry["recompile_storm"] = True
         with self._lock:
             ring = self._obs.get(client_id)
             if ring is None:
@@ -275,6 +307,7 @@ class ClientLedger:
             if resp is not None:
                 timings = resp.get("timings") or {}
                 loss_hist = resp.get("loss_history") or []
+                compute = resp.get("compute") or {}
                 self.observe(
                     cid, round_name, "reported",
                     train_s=timings.get("train_s"),
@@ -284,6 +317,9 @@ class ClientLedger:
                     hb_rtt_s=timings.get("hb_rtt_s"),
                     n_samples=resp.get("n_samples"),
                     via_edge=resp.get("via_edge"),
+                    mfu=compute.get("mfu"),
+                    compile_s=compute.get("compile_s"),
+                    recompile_storm=compute.get("recompile_storm"),
                 )
             elif cid in participants:
                 self.observe(cid, round_name, "straggler")
@@ -350,7 +386,8 @@ class ClientLedger:
             if med is not None:
                 info["train_s_median"] = round(med, 6)
             for key in ("train_s", "upload_bytes", "upload_bw_bps",
-                        "loss", "hb_rtt_s", "via_edge"):
+                        "loss", "hb_rtt_s", "via_edge",
+                        "mfu", "compile_s"):
                 for o in reversed(reported):
                     if o.get(key) is not None:
                         info[key] = o[key]

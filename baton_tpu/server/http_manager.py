@@ -90,6 +90,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from baton_tpu.core.model import FedModel
+from baton_tpu.obs import compute as obs_compute
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.server import wire
 from baton_tpu.server.blobs import BlobStore
@@ -136,6 +137,66 @@ def _clean_timings(raw: Any) -> Optional[dict]:
             and val >= 0
         ):
             out[key] = float(val)
+    return out or None
+
+
+#: compute-record fields accepted off the wire (obs/compute.py schema).
+#: Numeric keys may also legitimately arrive as ``None`` — but only
+#: with a non-empty ``<key>_reason``/``<key>_source`` string sibling
+#: (the null-with-reason invariant, enforced here at the door).
+_COMPUTE_NUM_KEYS = (
+    "train_s", "steps", "n_chips", "samples_per_sec",
+    "samples_per_sec_per_chip", "mfu", "flops_per_sample",
+    "compile_s", "recompiles", "peak_hbm_gb",
+)
+_COMPUTE_STR_KEYS = (
+    "device_kind", "model_family",
+)
+_COMPUTE_BOOL_KEYS = ("cache_hit", "recompile_storm")
+_COMPUTE_MAX_STR = 256
+
+
+def _clean_compute(raw: Any) -> Optional[dict]:
+    """Sanitize a worker/edge-supplied compute record: known keys only,
+    finite non-negative numbers, bounded strings, and the
+    null-with-reason invariant — a null metric WITHOUT a reason/source
+    sibling is dropped (never stored as a bare null), and reason
+    strings survive only next to the field they excuse."""
+    if not isinstance(raw, dict):
+        return None
+    out: dict = {}
+    for key in _COMPUTE_NUM_KEYS:
+        val = raw.get(key)
+        if (
+            isinstance(val, (int, float))
+            and not isinstance(val, bool)
+            and math.isfinite(val)
+            and val >= 0
+        ):
+            out[key] = float(val)
+        elif val is None and key in raw:
+            why = raw.get(f"{key}_reason") or raw.get(f"{key}_source")
+            if isinstance(why, str) and why:
+                out[key] = None
+                out[f"{key}_reason"] = why[:_COMPUTE_MAX_STR]
+    for key in _COMPUTE_STR_KEYS:
+        val = raw.get(key)
+        if isinstance(val, str) and val:
+            out[key] = val[:_COMPUTE_MAX_STR]
+        elif val is None and key in raw:
+            why = raw.get(f"{key}_reason") or raw.get(f"{key}_source")
+            if isinstance(why, str) and why:
+                out[key] = None
+                out[f"{key}_reason"] = why[:_COMPUTE_MAX_STR]
+    for key in _COMPUTE_BOOL_KEYS:
+        if isinstance(raw.get(key), bool):
+            out[key] = raw[key]
+    # provenance sources riding next to MEASURED values (e.g.
+    # peak_hbm_gb_source = "allocator" | "xla_memory_analysis")
+    for key in _COMPUTE_NUM_KEYS:
+        src = raw.get(f"{key}_source")
+        if out.get(key) is not None and isinstance(src, str) and src:
+            out[f"{key}_source"] = src[:_COMPUTE_MAX_STR]
     return out or None
 
 
@@ -924,8 +985,20 @@ class Experiment:
         self, request: web.Request
     ) -> web.Response:
         """``GET /{name}/metrics/history`` — the timestamped snapshot
-        ring (oldest first) recorded by the background history task."""
-        history = self.metrics.history()
+        ring (oldest first) recorded by the background history task.
+        ``?since=<ts>`` returns only samples strictly newer than the
+        given wall-clock timestamp, so pollers (the ops console) fetch
+        deltas instead of the full ring every refresh."""
+        since = None
+        raw_since = request.query.get("since")
+        if raw_since is not None:
+            try:
+                since = float(raw_since)
+            except ValueError:
+                return web.json_response(
+                    {"err": "Bad since Timestamp"}, status=400
+                )
+        history = self.metrics.history(since=since)
         return web.json_response({
             "interval_s": self.metrics_history_interval_s,
             "samples": len(history),
@@ -1045,6 +1118,39 @@ class Experiment:
             if s.get("service") == self.tracer.service
             and s.get("name") != "round"
         }
+        # compute plane: fold the reporters' per-client compute records
+        # (obs/compute.py) into one round section — every unmeasured
+        # aggregate is null-with-reason, never a bare null — and export
+        # the latest round's values as gauges for /metrics + the console
+        compute_section = obs_compute.summarize_round(
+            [r.get("compute") for r in responses.values()
+             if isinstance(r, dict)]
+        )
+        for gauge, key in (
+            ("compute_mfu", "mfu"),
+            ("compute_samples_per_sec_per_chip", "samples_per_sec_per_chip"),
+            ("compute_peak_hbm_gb", "peak_hbm_gb"),
+            ("compute_steps", "steps"),
+        ):
+            val = compute_section.get(key)
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                self.metrics.set_gauge(gauge, float(val))
+        self.metrics.set_gauge(
+            "compute_reporters", compute_section["reporters"]
+        )
+        self.metrics.set_gauge(
+            "compute_recompile_storm",
+            1.0 if compute_section.get("recompile_storms") else 0.0,
+        )
+        cs = compute_section.get("compile_s")
+        if isinstance(cs, (int, float)) and not isinstance(cs, bool):
+            # root-side compile histogram (worst reporter per round) with
+            # this round's trace as the exemplar: a p99 compile spike on
+            # /metrics links straight to the round that recompiled
+            self.metrics.observe(
+                "compute_compile_s", float(cs),
+                exemplar=(trace_id, tracing.root_span_id(trace_id)),
+            )
         self.rounds_log.append({
             "round": round_name,
             "round_index": self.rounds.n_rounds,
@@ -1060,6 +1166,7 @@ class Experiment:
             "bytes_broadcast": deltas.get("bytes_broadcast", 0.0),
             "counters_delta": deltas,
             "phase_s": phases,
+            "compute": compute_section,
         })
 
     def _new_stream_acc(self):
@@ -1289,6 +1396,13 @@ class Experiment:
             timings["upload_s"] = round(upload_s, 6)
         if timings:
             response["timings"] = timings
+        # per-round compute record (obs/compute.py): sanitized at the
+        # door, folded into the round's SLO record + fleet ledger
+        compute = _clean_compute(meta.get("compute"))
+        if compute is not None:
+            response["compute"] = compute
+        elif meta.get("compute") is not None:
+            self.metrics.inc("compute_records_invalid")
         acc = self._stream_acc
         if acc is not None and not response["masked"]:
             # streaming FedAvg: acceptance bookkeeping FIRST (no await
@@ -1416,12 +1530,13 @@ class Experiment:
                     [float(x) for x in (c.get("loss_history") or [])],
                     int(c.get("bytes") or 0),
                     _clean_timings(c.get("timings")),
+                    _clean_compute(c.get("compute")),
                 )
                 for cid, c in sorted(contributors.items())
             ]
         except (AttributeError, TypeError, ValueError):
             return web.json_response({"err": "Bad Edge Partial"}, status=400)
-        for cid, w, uid, losses, nbytes, timings in parsed:
+        for cid, w, uid, losses, nbytes, timings, compute in parsed:
             if not (w > 0) or not math.isfinite(w):
                 return web.json_response(
                     {"err": "Bad Edge Partial"}, status=400
@@ -1440,7 +1555,7 @@ class Experiment:
                 # folds but the credit stays with the direct delivery
                 self.metrics.inc("edge_contributor_conflicts")
                 continue
-            credited.append((cid, w, uid, losses, nbytes, timings))
+            credited.append((cid, w, uid, losses, nbytes, timings, compute))
         if total_w <= 0:
             return web.json_response({"err": "Bad Edge Partial"}, status=400)
         # edge-tier phase wall times ride the partial's meta: folded
@@ -1473,7 +1588,7 @@ class Experiment:
         # — partial or direct — sees client_responses/_edge_partial_ids
         if update_id is not None:
             self._edge_partial_ids.add((client_id, update_id))
-        for cid, w, uid, losses, nbytes, timings in credited:
+        for cid, w, uid, losses, nbytes, timings, compute in credited:
             resp = {
                 "masked": False,
                 "n_samples": w,
@@ -1486,6 +1601,8 @@ class Experiment:
                 resp["upload_bytes"] = nbytes
             if timings:
                 resp["timings"] = timings
+            if compute is not None:
+                resp["compute"] = compute
             self.rounds.client_end(cid, resp)
             self.registry.record_update(cid, round_name)
             self.metrics.inc("updates_received")
@@ -2316,6 +2433,14 @@ class Experiment:
             "n_samples": float(result.n_samples_total),
             "loss_history": [float(x) for x in np.asarray(result.loss_history)],
         }
+        # the engine leaves its per-round compute record (MFU/compile/
+        # HBM) in last_compute; fold it through the same sanitizer the
+        # wire path uses so the SLO record sees one schema
+        sim_compute = _clean_compute(
+            getattr(self.simulator, "last_compute", None)
+        )
+        if sim_compute is not None:
+            response["compute"] = sim_compute
         result_sd = params_to_state_dict(result.params)
         if self._stream_acc is not None:
             # the simulated cohort streams like any other participant

@@ -61,6 +61,7 @@ import numpy as np
 
 from baton_tpu.core.model import FedModel
 from baton_tpu.core.training import LocalTrainer, make_local_trainer
+from baton_tpu.obs.compute import ComputeProbe
 from baton_tpu.ops.padding import pad_dataset, round_up
 from baton_tpu.server import wire
 from baton_tpu.server.state import params_to_state_dict, state_dict_to_params
@@ -219,6 +220,11 @@ class ExperimentWorker:
             self.trainer = self._with_progress_hook(make_local_trainer(model))
         else:
             self.trainer = trainer
+        # compute-plane probe (obs/compute.py): one record per round —
+        # compile tracking keyed on the trainer's shape signature, MFU
+        # when the model family has FLOPs accounting, null-with-reason
+        # otherwise. The record rides update meta to the manager.
+        self.compute_probe = ComputeProbe(model=model)
         self.app = app
         self.port = port
         self.worker_host = worker_host
@@ -1112,6 +1118,61 @@ class ExperimentWorker:
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.json_response(self.metrics.snapshot())
 
+    def _record_compute(
+        self,
+        train_sig: tuple,
+        train_s: float,
+        n_samples: int,
+        n_epoch: int,
+        steps: int,
+        t_wall0: float,
+    ) -> Optional[dict]:
+        """Build this round's compute record (obs/compute.py) and publish
+        it locally: a ``compute`` child span under the active
+        ``local_train`` span, the ``compute_compile_s`` histogram with a
+        trace exemplar, and latest-round gauges. Returns the record for
+        the update meta (None only if the probe itself fails — the round
+        must never die on telemetry)."""
+        try:
+            compute = self.compute_probe.record_round(
+                key="local_train",
+                signature=train_sig,
+                train_s=train_s,
+                n_samples=n_samples,
+                n_epochs=n_epoch,
+                steps=steps,
+            )
+        except Exception:
+            return None
+        ctx = tracing.current_context()
+        if ctx is not None:
+            self.tracer.record_span(
+                "compute", ctx[0], t_wall0, time.time(),
+                parent_id=ctx[1],
+                **{k: v for k, v in compute.items() if v is not None},
+            )
+        compile_s = compute.get("compile_s")
+        if isinstance(compile_s, (int, float)):
+            self.metrics.observe(
+                "compute_compile_s", float(compile_s), exemplar=ctx
+            )
+        if not compute.get("cache_hit") and compute.get("recompiles"):
+            self.metrics.inc("compute_recompiles")
+        for gauge, key in (
+            ("compute_mfu", "mfu"),
+            ("compute_samples_per_sec_per_chip", "samples_per_sec_per_chip"),
+            ("compute_peak_hbm_gb", "peak_hbm_gb"),
+            ("compute_steps", "steps"),
+        ):
+            val = compute.get(key)
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                self.metrics.set_gauge(gauge, float(val))
+        self.metrics.set_gauge(
+            "compute_recompile_storm",
+            1.0 if compute.get("recompile_storm") else 0.0,
+        )
+        return compute
+
     async def _run_round(self, round_name: str, n_epoch: int) -> None:
         # reset per-round progress so round N+1's zero-epochs state is
         # distinguishable from round N's completion
@@ -1129,10 +1190,26 @@ class ExperimentWorker:
                     {k: np.asarray(v) for k, v in data.items()}, capacity
                 )
                 assert n == n_samples or n_samples <= n
+                try:
+                    sig = self.trainer.train_signature(padded, n_epoch)
+                    steps = self.trainer.steps_per_round(capacity, n_epoch)
+                except Exception:
+                    # delegating trainer wrappers (chaos harnesses proxy
+                    # only ``train``) need not expose the accounting
+                    # helpers — derive the shape signature locally;
+                    # build_record defaults steps epoch-wise
+                    sig = (
+                        tuple(sorted(
+                            (k, tuple(v.shape), str(v.dtype))
+                            for k, v in padded.items()
+                        )),
+                        int(n_epoch),
+                    )
+                    steps = None
                 params, _, losses = self.trainer.train(
                     self.params, padded, np.int32(n_samples), sub, n_epoch
                 )
-                return params, np.asarray(losses)
+                return params, np.asarray(losses), sig, steps
 
             # explicit derived trace id: under a live traceparent
             # context (copied into this task at ensure_future) the span
@@ -1145,7 +1222,10 @@ class ExperimentWorker:
             ) as train_sp:
                 loop = asyncio.get_running_loop()
                 t_train0 = loop.time()
-                params, loss_history = await asyncio.to_thread(train)
+                t_wall0 = time.time()
+                params, loss_history, train_sig, steps = (
+                    await asyncio.to_thread(train)
+                )
                 if self.train_time_scale > 1.0:
                     # pad to scale× the measured compute time: simulated
                     # slow hardware, same numerics (see __init__ doc)
@@ -1163,6 +1243,9 @@ class ExperimentWorker:
                     "local_train_s", train_s,
                     exemplar=tracing.current_context(),
                 )
+                compute = self._record_compute(
+                    train_sig, train_s, n_samples, n_epoch, steps, t_wall0
+                )
             self.params = params
             await self.report_update(
                 round_name, n_samples, loss_history,
@@ -1170,6 +1253,7 @@ class ExperimentWorker:
                     "train_s": train_s,
                     "hb_rtt_s": self._last_hb_rtt,
                 },
+                compute=compute,
             )
         finally:
             self.round_in_progress = False
@@ -1177,6 +1261,7 @@ class ExperimentWorker:
     async def report_update(
         self, round_name: str, n_samples: int, loss_history,
         timings: Optional[dict] = None,
+        compute: Optional[dict] = None,
     ) -> None:
         """Encode the trained update and park it in the outbox; actual
         delivery (with retries) happens in :meth:`_drain_outbox`. Returns
@@ -1184,7 +1269,12 @@ class ExperimentWorker:
         never waits on the network. ``timings`` (self-reported seconds,
         e.g. ``{"train_s": …, "hb_rtt_s": …}``) ride along in the update
         metadata for the manager's fleet ledger — advisory data, so None
-        entries are simply dropped rather than sent."""
+        entries are simply dropped rather than sent. ``compute`` is the
+        round's compute record (obs/compute.py) — shipped verbatim
+        (nulls INCLUDED: each carries its reason field; the manager's
+        sanitizer enforces that invariant server-side). The meta dict is
+        shared by every encode branch and the chunked upload slices the
+        same body, so both plain and chunked paths carry it."""
         update_id = random_key(16)
         meta = {
             "update_name": round_name,
@@ -1200,6 +1290,8 @@ class ExperimentWorker:
             }
             if cleaned:
                 meta["timings"] = cleaned
+        if compute:
+            meta["compute"] = compute
         # use the secure state captured AT BROADCAST TIME, not a fresh
         # registry fetch: if the round was re-keyed since (abort/restart
         # reusing the name mid-round), a fresh fetch returns the NEW

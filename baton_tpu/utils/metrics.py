@@ -130,6 +130,9 @@ DECLARED_COUNTERS = frozenset({
     # fleet health plane (server/fleet.py ledger)
     "fleet_observations",
     "history_snapshots",
+    # compute plane (baton_tpu/obs/compute.py probe records)
+    "compute_recompiles",        # worker: jit cache misses after the first
+    "compute_records_invalid",   # manager: records dropped by sanitizer
     # manager: edge-tier phase wall times folded into round counter
     # deltas (float seconds; shipped per round in the partial's meta)
     "edge_phase_fold_s",
@@ -160,6 +163,8 @@ DECLARED_TIMERS = frozenset({
     # fleet health plane
     "local_train_s",    # worker: self-measured local training wall time
     "upload_s",         # worker: one update POST end to end
+    # compute plane (baton_tpu/obs/compute.py probe)
+    "compute_compile_s",  # worker/engine: jit compile wall per round
 })
 
 # Timers whose histogram must carry a trace exemplar: every direct
@@ -171,6 +176,7 @@ DECLARED_EXEMPLAR_TIMERS = frozenset({
     "round_s",
     "local_train_s",
     "upload_s",
+    "compute_compile_s",
 })
 
 # Gauges set under baton_tpu/server/ (BTL030 audits .set_gauge() names).
@@ -209,6 +215,13 @@ DECLARED_GAUGES = frozenset({
     "fleet_clients_flaky",
     "fleet_clients_degrading",
     "fleet_clients_inactive",
+    # compute plane (baton_tpu/obs/compute.py probe records; latest round)
+    "compute_mfu",
+    "compute_samples_per_sec_per_chip",
+    "compute_peak_hbm_gb",
+    "compute_recompile_storm",
+    "compute_steps",
+    "compute_reporters",
 })
 
 
@@ -382,10 +395,15 @@ class Metrics:
         self.inc("history_snapshots")
         return dict(snap, samples=n)
 
-    def history(self) -> List[dict]:
-        """The recorded snapshot ring, oldest first."""
+    def history(self, since: Optional[float] = None) -> List[dict]:
+        """The recorded snapshot ring, oldest first. ``since`` keeps
+        only samples with ``ts`` strictly after it, so pollers (the ops
+        console) can fetch deltas instead of the full ring."""
         with self._lock:
-            return list(self._history)
+            samples = list(self._history)
+        if since is None:
+            return samples
+        return [s for s in samples if s.get("ts", 0.0) > since]
 
 
 class LoopLagProbe:

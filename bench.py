@@ -54,23 +54,16 @@ PROBE_TIMEOUTS_S = (
 )
 PROBE_RETRY_COOLDOWN_S = 15.0
 
-# ResNet-18 (CIFAR-10 variant, 32x32 input): 0.557 GMAC forward per
-# image = 1.11 GFLOP (x2 MAC->FLOP); training ~3x forward (fwd + 2x
-# bwd).
-RESNET18_CIFAR_FWD_FLOPS_PER_IMG = 1.11e9
-TRAIN_FLOPS_PER_IMG = 3.0 * RESNET18_CIFAR_FWD_FLOPS_PER_IMG
-
-# Peak dense-matmul throughput by device kind (bf16, FLOP/s) — the MFU
-# denominator. Source: public TPU spec sheets.
-TPU_PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,   # Trillium / v6e
-    "TPU v6e": 918e12,
-}
+# Analytic FLOPs accounting and the peak-FLOPs table live in the shared
+# compute probe (baton_tpu/obs/compute.py) — the live round loop reports
+# MFU with the exact same constants, so bench and live numbers cannot
+# diverge. Re-exported here for older result-parsing scripts.
+from baton_tpu.obs.compute import (  # noqa: E402
+    RESNET18_CIFAR_FWD_FLOPS_PER_IMG,
+    TRAIN_FLOPS_PER_IMG,
+    TPU_PEAK_FLOPS,
+    compute_mfu,
+)
 
 
 def log(msg: str) -> None:
@@ -634,10 +627,10 @@ def main() -> None:
     peak_hbm_source = None
     device_kind = getattr(devs[0], "device_kind", platform)
     if not degraded:
-        peak = next((v for k, v in TPU_PEAK_FLOPS.items()
-                     if device_kind.startswith(k)), None)
-        if peak:
-            mfu = samples_per_sec * TRAIN_FLOPS_PER_IMG / peak
+        # shared MFU formula (this bench runs one chip's shard, so
+        # samples_per_sec IS the per-chip throughput)
+        mfu, _mfu_reason = compute_mfu(
+            samples_per_sec, TRAIN_FLOPS_PER_IMG, device_kind)
     # allocator peak when surfaced; XLA's static memory plan for the
     # round's wave kernel otherwise (the axon tunnel reports no
     # allocator stats). Budget-gated inside the helper: the fallback

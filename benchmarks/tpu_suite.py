@@ -57,9 +57,14 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 OUT_JSONL = os.path.join(REPO, "benchmarks", "tpu_results.jsonl")
 
-V5E_PEAK_BF16 = 197e12
-# ResNet-18 CIFAR fwd FLOPs/image (bench.py); train ~ 3x fwd
-RESNET_TRAIN_FLOPS_PER_IMG = 3.0 * 1.11e9
+# FLOPs constants come from the shared compute probe (one accounting
+# for bench, live rounds, and this suite)
+from baton_tpu.obs.compute import (  # noqa: E402
+    TPU_PEAK_FLOPS,
+    TRAIN_FLOPS_PER_IMG as RESNET_TRAIN_FLOPS_PER_IMG,
+)
+
+V5E_PEAK_BF16 = TPU_PEAK_FLOPS["TPU v5e"]
 
 # BATON_SUITE_SMOKE=1 shrinks every stage to CPU-compilable sizes so the
 # suite's plumbing (children, JSONL, parsing) is testable without the

@@ -19,13 +19,18 @@ Artifacts (``--artifacts DIR``, default ``./artifacts``):
 * ``metrics_history.json`` — ``GET /metrics/history`` from all three
   nodes (the timestamped snapshot rings);
 * ``ops_console.json``  — one ``python -m baton_tpu.ops --once --json``
-  poll of the live federation.
+  poll of the live federation;
+* ``compute_profile.json`` — the compute plane: every round's
+  ``compute`` section from ``rounds.jsonl`` plus each worker's last
+  ``compute_*`` gauges (throughput/steps measured on this CPU tier;
+  MFU/HBM null-with-reason).
 
 Exits non-zero if a round fails, the trace is missing spans from any
 tier, the 8x-slowed worker is not classified ``slow``, the round
 record does not name it with a reason, the ``local_train_s`` exemplar
 does not resolve to a fetchable trace containing that worker's span,
-or the ops console probe fails.
+the ops console probe fails, or compute telemetry is missing from any
+tier (worker gauges, edge ledger, root round records).
 
 Run locally:  JAX_PLATFORMS=cpu python scripts/smoke_trace.py
 """
@@ -255,6 +260,40 @@ async def _smoke(artifacts: str) -> int:
         assert slow_worker.client_id in why, (why, records[-1])
         assert why[slow_worker.client_id].startswith("slow:"), why
 
+        # -- compute plane (all three tiers) ------------------------
+        # root tier: every round record carries a valid compute
+        # section — throughput/steps measured, MFU + peak HBM
+        # null-with-reason on this CPU tier (never a bare null)
+        from baton_tpu.obs.compute import validate_record
+        for r in records:
+            comp = r.get("compute")
+            assert isinstance(comp, dict), ("round missing compute", r)
+            assert validate_record(comp) == [], (comp, r["round"])
+            assert comp["reporters"] >= 3, comp
+            assert comp["steps"] and comp["steps"] > 0, comp
+            assert comp["samples_per_sec_per_chip"] > 0, comp
+            assert comp["compile_s"] is not None, comp
+            assert comp["mfu"] is None and comp["mfu_reason"], comp
+            assert comp["peak_hbm_gb"] is None \
+                and comp["peak_hbm_gb_reason"], comp
+        # worker tier: each worker exported its last round's gauges
+        worker_compute = {}
+        for w in workers:
+            wg = w.metrics.snapshot()["gauges"]
+            assert wg.get("compute_steps"), (w.client_id, wg)
+            assert wg.get("compute_samples_per_sec_per_chip"), \
+                (w.client_id, wg)
+            worker_compute[w.client_id] = {
+                k: v for k, v in wg.items() if k.startswith("compute_")
+            }
+        # edge tier: the compute record survived the edge fold — the
+        # edge ledgers saw per-client compile_s observations
+        for e in edges:
+            eclients = health[e.edge_name]["clients"]
+            assert any(
+                i.get("compile_s") is not None for i in eclients.values()
+            ), (e.edge_name, eclients)
+
         # -- ops console (CI probe mode) ----------------------------
         console = await _run_console_once(
             mport, name, [e.port for e in edges]
@@ -262,6 +301,12 @@ async def _smoke(artifacts: str) -> int:
         assert console["root"]["up"], console["root"]
         assert all(e["up"] for e in console["edges"]), console["edges"]
         assert console["root"]["health"]["clients"], console["root"]
+        # the console sees the same compute gauges the manager exports
+        cg = console["root"]["metrics"]["gauges"]
+        mg = metrics["gauges"]
+        for k in ("compute_reporters", "compute_steps",
+                  "compute_samples_per_sec_per_chip"):
+            assert cg.get(k) == mg.get(k) and cg.get(k), (k, cg, mg)
 
         with open(os.path.join(artifacts, "round_trace.json"), "w") as fh:
             json.dump(trace, fh, indent=2)
@@ -281,6 +326,14 @@ async def _smoke(artifacts: str) -> int:
         with open(os.path.join(artifacts, "ops_console.json"),
                   "w") as fh:
             json.dump(console, fh, indent=2)
+        with open(os.path.join(artifacts, "compute_profile.json"),
+                  "w") as fh:
+            json.dump({
+                "rounds": [
+                    dict(r["compute"], round=r["round"]) for r in records
+                ],
+                "workers": worker_compute,
+            }, fh, indent=2)
 
         services = {
             e["args"]["name"]

@@ -955,6 +955,76 @@ def test_btl032_scoped_and_suppressible():
                 registry=EXEMPLAR_REGISTRY) == []
 
 
+# ----------------------------------------------------------------------
+# compute-plane metric names — the probe's emission sites live under
+# server/, so a typo'd compute name would silently zero a gated
+# compute:* SLO metric; these fixtures pin the names BTL030/BTL032 must
+# accept and reject
+
+COMPUTE_REGISTRY = {
+    "counters": frozenset({"compute_recompiles",
+                           "compute_records_invalid"}),
+    "counter_prefixes": (),
+    "timers": frozenset({"compute_compile_s"}),
+    "gauges": frozenset({"compute_mfu",
+                         "compute_samples_per_sec_per_chip",
+                         "compute_peak_hbm_gb",
+                         "compute_recompile_storm",
+                         "compute_steps", "compute_reporters"}),
+    "exemplar_timers": frozenset({"compute_compile_s"}),
+}
+
+
+def test_compute_names_good_fixture_passes():
+    findings = lint(
+        """
+        def f(m, dt, tracing):
+            m.inc("compute_recompiles")
+            m.inc("compute_records_invalid")
+            m.observe("compute_compile_s", dt,
+                      exemplar=tracing.current_context())
+            m.set_gauge("compute_mfu", 0.41)
+            m.set_gauge("compute_samples_per_sec_per_chip", 812.0)
+            m.set_gauge("compute_peak_hbm_gb", 3.2)
+            m.set_gauge("compute_recompile_storm", 1.0)
+            m.set_gauge("compute_steps", 24)
+            m.set_gauge("compute_reporters", 4)
+        """,
+        rules=["BTL030", "BTL032"],
+        registry=COMPUTE_REGISTRY,
+    )
+    assert findings == []
+
+
+def test_compute_name_typos_and_bare_compile_observe_flagged():
+    findings = lint(
+        """
+        def f(m, dt):
+            m.inc("compute_recompilez")
+            m.set_gauge("compute_mfu_pct", 41.0)
+            m.observe("compute_compile_s", dt)
+        """,
+        rules=["BTL030", "BTL032"],
+        registry=COMPUTE_REGISTRY,
+    )
+    assert sorted(rules_of(findings)) == ["BTL030", "BTL030", "BTL032"]
+
+
+def test_real_metrics_registry_declares_compute_names():
+    # parse the actual utils/metrics.py the same way the engine does:
+    # the probe's names must be declared there, with compute_compile_s
+    # in the exemplar set so bare observes keep getting flagged
+    from baton_tpu.analysis.engine import _parse_counter_registry
+    metrics_py = (pathlib.Path(__file__).resolve().parents[1]
+                  / "baton_tpu" / "utils" / "metrics.py")
+    reg = _parse_counter_registry(metrics_py)
+    assert reg is not None
+    assert {"compute_recompiles", "compute_records_invalid"} <= reg["counters"]
+    assert "compute_compile_s" in reg["timers"]
+    assert "compute_compile_s" in reg["exemplar_timers"]
+    assert COMPUTE_REGISTRY["gauges"] <= reg["gauges"]
+
+
 def test_all_rules_table():
     table = all_rules()
     assert set(table) == {
