@@ -36,6 +36,11 @@ BTL010   tracer hygiene inside ``@jax.jit``/``shard_map`` functions
          values, ``np.asarray``, module-state mutation); traced
          values followed by dataflow taint through assignments,
          ``self.*`` writes, containers, and call results
+BTL011   ``jax.jit`` applied to a round-step/training function whose
+         parameters carry model-state pytrees (``params``,
+         ``opt_states``, ``anchors``...) with no donation decision —
+         pass ``donate_argnums`` (``()`` records an audited no) or
+         suppress with a justified ``# batonlint: allow[BTL011]``
 BTL020   raw ``request.read()`` / uncapped ``request.json()`` in an
          aiohttp handler (use ``utils.read_body_capped`` /
          ``utils.read_json_capped``)

@@ -11,6 +11,7 @@ observability) and add known-bad/known-good fixtures to
 from baton_tpu.analysis.checkers import (  # noqa: F401
     blocking,
     counters,
+    donation,
     exemplars,
     locks,
     spans,

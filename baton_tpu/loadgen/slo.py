@@ -413,6 +413,39 @@ def derive_bench_metrics(parsed: dict) -> "tuple[Dict[str, float], Dict[str, str
             )
             if reason:
                 skips[name] = str(reason)
+    # donation on/off HBM-plan comparison and the wave1024 recorded
+    # number: null with a recorded ``*_reason`` skips; null without one
+    # regresses. Records from before bench.py emitted these fields are
+    # recognizable by the missing ``donation_enabled`` marker and skip
+    # with an explicit pre-schema note instead of failing the gate on
+    # history the new code never measured.
+    pre_schema = "donation_enabled" not in parsed
+    donation = parsed.get("donation_hbm")
+    if isinstance(donation, dict):
+        delta = donation.get("delta_gb")
+        if isinstance(delta, (int, float)) and not isinstance(delta, bool):
+            metrics["bench:donation_hbm_delta_gb"] = float(delta)
+        for variant in ("donate_on", "donate_off"):
+            plan = (donation.get(variant) or {}).get("plan_gb")
+            if isinstance(plan, (int, float)) and not isinstance(plan, bool):
+                metrics[f"bench:donation_{variant}_plan_gb"] = float(plan)
+    elif parsed.get("donation_hbm_reason"):
+        skips["bench:donation_hbm_delta_gb"] = str(
+            parsed["donation_hbm_reason"])
+    elif pre_schema:
+        skips["bench:donation_hbm_delta_gb"] = (
+            "record predates the donation-plan bench stage")
+    wave1024 = parsed.get("wave1024_recorded")
+    if isinstance(wave1024, dict):
+        rps = wave1024.get("rounds_per_sec")
+        if isinstance(rps, (int, float)) and not isinstance(rps, bool):
+            metrics["bench:wave1024_rounds_per_sec"] = float(rps)
+    elif parsed.get("wave1024_reason"):
+        skips["bench:wave1024_rounds_per_sec"] = str(
+            parsed["wave1024_reason"])
+    elif pre_schema:
+        skips["bench:wave1024_rounds_per_sec"] = (
+            "record predates the wave1024_reason bench field")
     flagship = parsed.get("flagship_mfu_recorded") or {}
     for rec in flagship.get("records") or []:
         model = rec.get("model")

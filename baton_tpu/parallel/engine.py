@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from baton_tpu.core.model import FedModel
 from baton_tpu.core.partition import PathPredicate, make_partition
@@ -46,7 +46,13 @@ from baton_tpu.obs.compute import ComputeProbe
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.ops.padding import round_up
 from baton_tpu.parallel.compat import shard_map
-from baton_tpu.parallel.mesh import CLIENT_AXIS, client_sharding
+from baton_tpu.parallel.mesh import CLIENT_AXIS, client_sharding, replicated_sharding
+from baton_tpu.parallel.partition import (
+    client_spec,
+    kernel_specs,
+    replicated_spec,
+    waved_client_spec,
+)
 from baton_tpu.parallel.tensor_parallel import MODEL_AXIS, shard_params_tp
 
 Params = Any
@@ -174,6 +180,16 @@ class FedSim:
         — a Llama-8B base physically cannot replicate per chip)."""
         return self.mesh is not None and MODEL_AXIS in self.mesh.axis_names
 
+    @property
+    def partition_rule_set(self) -> str:
+        """Name of the :data:`~baton_tpu.parallel.partition.DEFAULT_RULE_SETS`
+        table governing this sim's placement — recorded in bench output."""
+        if self.is_hybrid:
+            return "transformer-tp"
+        if self.mesh is not None:
+            return "client-stacked"
+        return "replicated"
+
     def _clients_per_wave_unit(self) -> int:
         """Wave sizes must be a multiple of the client-axis extent."""
         if self.mesh is None:
@@ -189,9 +205,7 @@ class FedSim:
         derives the whole round program — per-client compute partitioned
         over ``clients``, every frozen-base matmul Megatron-sharded over
         ``model`` — with no shard_map or manual collectives."""
-        params = jax.device_put(
-            params, NamedSharding(self.mesh, P())
-        )
+        params = jax.device_put(params, replicated_sharding(self.mesh))
         if frozen is not None:
             # frozen is a flat leaf list (partition.split); shard each
             # leaf by its ORIGINAL tree path so the Megatron name rules
@@ -245,11 +259,15 @@ class FedSim:
     # when a round fits in one wave (jnp identity slices return the same
     # buffer), so donating them would invalidate data the caller reuses
     # across rounds. Donation lives where it is safe and large: the
-    # fused round runner donates params+opt state (run_rounds_fused,
-    # donate_argnums) and LocalTrainer.train_with_opt_state donates the
-    # per-client optimizer state (training.py) — the two buffers that
-    # would otherwise be double-buffered per round.
-    @partial(jax.jit, static_argnums=(0, 6))
+    # fused round runner donates params+opt state by default
+    # (run_rounds_fused, donate_argnums), the wave loop donates its
+    # model-sized psum accumulator (_acc_tree_add), and
+    # LocalTrainer.train_with_opt_state donates the per-client optimizer
+    # state (training.py) — the buffers that would otherwise be
+    # double-buffered per round.
+    # donation decided no: params is the round's retained anchor,
+    # re-read by every wave (and by FedProx as the prox center)
+    @partial(jax.jit, static_argnums=(0, 6))  # batonlint: allow[BTL011]
     def _wave_sums_vmap(self, params, frozen, data, n_samples, rngs, n_epochs):
         return self._wave_sums_raw(params, frozen, data, n_samples, rngs, n_epochs)
 
@@ -268,7 +286,9 @@ class FedSim:
 
         return jax.vmap(one_client)(data, n_samples, rngs)
 
-    @partial(jax.jit, static_argnums=(0, 6))
+    # donation decided no: same retained-anchor contract as
+    # _wave_sums_vmap
+    @partial(jax.jit, static_argnums=(0, 6))  # batonlint: allow[BTL011]
     def _wave_params_vmap(self, params, frozen, data, n_samples, rngs, n_epochs):
         return self._wave_params_raw(params, frozen, data, n_samples, rngs,
                                      n_epochs)
@@ -285,12 +305,14 @@ class FedSim:
                     params, frozen, data, n_samples, rngs, n_epochs
                 )
 
-            cache[n_epochs] = jax.jit(shard_map(
+            in_specs, out_specs = kernel_specs("engine.wave_params")
+            # donation decided no: params is the caller-retained
+            # anchor, re-read across waves
+            cache[n_epochs] = jax.jit(shard_map(  # batonlint: allow[BTL011]
                 kernel,
                 mesh=mesh,
-                in_specs=(P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
-                          P(CLIENT_AXIS)),
-                out_specs=(P(CLIENT_AXIS), P(CLIENT_AXIS)),
+                in_specs=in_specs,
+                out_specs=out_specs,
                 check_vma=False,
             ))
         return cache[n_epochs]
@@ -317,14 +339,20 @@ class FedSim:
                 wtot = jax.lax.psum(local_w, CLIENT_AXIS)
                 return psum, lsum, wtot, client_losses
 
+            in_specs, out_specs = kernel_specs("engine.wave_sums")
             sharded = shard_map(
                 kernel,
                 mesh=mesh,
-                in_specs=(P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS)),
-                out_specs=(P(), P(), P(), P(CLIENT_AXIS)),
+                in_specs=in_specs,
+                out_specs=out_specs,
                 check_vma=False,
             )
-            cache[n_epochs] = (sharded, jax.jit(sharded))
+            # donation decided no: params is the caller-retained
+            # anchor, re-read across waves
+            cache[n_epochs] = (
+                sharded,
+                jax.jit(sharded),  # batonlint: allow[BTL011]
+            )
         sharded, jitted = cache[n_epochs]
         return sharded if raw else jitted
 
@@ -547,7 +575,7 @@ class FedSim:
             else:
                 psum, lsum, wtot, closs = call(d, n, r)
                 psum_acc = (
-                    psum if psum_acc is None else agg.tree_add(psum_acc, psum)
+                    psum if psum_acc is None else _acc_tree_add(psum_acc, psum)
                 )
             lsum_acc = lsum if lsum_acc is None else lsum_acc + lsum
             w_acc = wtot if w_acc is None else w_acc + wtot
@@ -595,6 +623,15 @@ class FedSim:
             aggregate = jax.tree_util.tree_map(
                 lambda s, ref: (s / denom).astype(ref.dtype), psum_acc, params
             )
+        if self.is_hybrid:
+            # GSPMD is free to leave the trainable aggregate
+            # model-sharded (it flows out of matmuls against the TP
+            # base), but the global state is logically replicated —
+            # pin it back to the partition layer's replicated rule so
+            # round outputs carry the same layout contract as inputs
+            aggregate = jax.device_put(
+                aggregate, replicated_sharding(self.mesh)
+            )
         loss_history = lsum_acc / denom
 
         if self.server_optimizer is not None:
@@ -622,7 +659,8 @@ class FedSim:
     # ------------------------------------------------------------------
     # federated evaluation: sample-weighted mean loss/accuracy over the
     # client axis — the eval-side analogue of the FedAvg weighting
-    @partial(jax.jit, static_argnums=(0,))
+    # donation decided no: evaluation never owns its inputs
+    @partial(jax.jit, static_argnums=(0,))  # batonlint: allow[BTL011]
     def _eval_sums_vmap(self, params, data, n_samples, rngs):
         def one(d, n, r):
             return client_eval_sums(self.model, params, d, n, r)
@@ -680,7 +718,8 @@ class FedSim:
             out["accuracy"] = totals["correct_sum"] / denom
         return out
 
-    @partial(jax.jit, static_argnums=(0,))
+    # donation decided no: evaluation never owns its inputs
+    @partial(jax.jit, static_argnums=(0,))  # batonlint: allow[BTL011]
     def _eval_sums_per_client(self, params, data, n_samples, rngs):
         def one(d, n, r):
             return client_eval_sums(self.model, params, d, n, r)
@@ -840,7 +879,7 @@ class FedSim:
     # fused rounds: the whole multi-round federated loop as ONE compiled
     # XLA program — lax.scan over rounds, lax.scan over waves inside.
     def _make_rounds_fused(self, n_epochs: int, n_rounds: int,
-                           donate: bool = False):
+                           donate: bool = True):
         cache = getattr(self, "_fused_cache", None)
         if cache is None:
             cache = self._fused_cache = {}
@@ -897,10 +936,11 @@ class FedSim:
             )
             return p, sos, losses  # losses [n_rounds, n_epochs]
 
-        # donate=True aliases the incoming params/server-opt buffers into
-        # the outputs (HBM hygiene: no double-buffered global state across
-        # the dispatch) — opt-in because it invalidates the caller's
-        # arrays on accelerator backends.
+        # donate=True (the default) aliases the incoming params/server-opt
+        # buffers into the outputs — HBM hygiene: no double-buffered
+        # global state across the dispatch. frozen (argnum 1) is NOT
+        # donated: partition.merge reads it after the call. Callers that
+        # must keep the old globals pass donate_buffers=False.
         fn = jax.jit(run, donate_argnums=(0, 5) if donate else ())
         cache[key] = fn
         return fn
@@ -916,7 +956,7 @@ class FedSim:
         wave_size=None,
         server_opt_state=None,
         return_server_opt_state: bool = False,
-        donate_buffers: bool = False,
+        donate_buffers: bool = True,
     ):
         """``run_rounds`` as a single XLA dispatch.
 
@@ -925,10 +965,20 @@ class FedSim:
         client's params live inside the scan) — use :meth:`run_round` /
         :meth:`run_rounds`, which apply them per round.
 
-        ``donate_buffers=True`` donates the params/server-opt input
-        buffers to XLA (the returned arrays alias them) — use on the
-        production path when the caller no longer needs the old globals;
-        the inputs become invalid on accelerator backends.
+        ``donate_buffers`` (default True) donates the params/server-opt
+        input buffers to XLA — the returned arrays alias them, so the
+        old globals are never double-buffered across the dispatch. On
+        accelerator backends the caller's ``params`` (and any
+        ``server_opt_state`` passed in) are INVALID after this returns;
+        pass ``donate_buffers=False`` to keep them (e.g. to re-run from
+        the same initial params). CPU ignores donation, so CPU tests are
+        unaffected either way.
+
+        Donation-safety audit (aliased buffers never read after the
+        fused call): argnum 0 is the post-``_split`` trainable tree and
+        argnum 5 the server opt state — neither local is read below the
+        ``fn(...)`` call; ``frozen`` IS read by ``partition.merge`` and
+        is deliberately not donated.
 
         The per-round Python of :meth:`run_round` (slicing, accumulation,
         the aggregate divide, the server update) all becomes traced code
@@ -968,7 +1018,7 @@ class FedSim:
         )
         n_w = n_samples.reshape(n_waves, wave)
         if self.mesh is not None:
-            shard = NamedSharding(self.mesh, P(None, CLIENT_AXIS))
+            shard = NamedSharding(self.mesh, waved_client_spec())
             data_w = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, shard), data_w
             )
@@ -989,6 +1039,16 @@ class FedSim:
         if return_server_opt_state:
             return new_params, history, server_opt_state
         return new_params, history
+
+
+# The model-sized accumulator of the non-fused wave loop: the previous
+# partial sum is donated into the add, so the loop holds ONE psum buffer
+# instead of two (old + new) at the accumulation point. Safe by
+# construction — the donated array is the previous wave's kernel output,
+# owned solely by the loop and rebound immediately.
+@partial(jax.jit, donate_argnums=(0,))
+def _acc_tree_add(acc, delta):
+    return agg.tree_add(acc, delta)
 
 
 def _server_update(server_optimizer, params, aggregate, opt_state):

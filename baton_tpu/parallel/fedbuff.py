@@ -44,16 +44,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from jax.sharding import NamedSharding, PartitionSpec as P
-
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.parallel.compat import shard_map
 from baton_tpu.parallel.engine import FedSim
 from baton_tpu.parallel.mesh import (
     CLIENT_AXIS,
     client_sharding,
+    replicated_sharding,
     require_clients_mesh,
 )
+from baton_tpu.parallel.partition import kernel_specs
 
 Params = Any
 
@@ -173,12 +173,14 @@ class FedBuff:
                     anchors, data, n_samples, rngs, n_epochs, frozen
                 )
 
-            cache[n_epochs] = jax.jit(shard_map(
+            in_specs, out_specs = kernel_specs("fedbuff.train")
+            # donation decided no: the anchor stack is re-read
+            # after the dispatch to form the staleness deltas
+            cache[n_epochs] = jax.jit(shard_map(  # batonlint: allow[BTL011]
                 kernel,
                 mesh=mesh,
-                in_specs=(P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS),
-                          P(CLIENT_AXIS), P()),
-                out_specs=(P(CLIENT_AXIS), P(CLIENT_AXIS)),
+                in_specs=in_specs,
+                out_specs=out_specs,
                 check_vma=False,
             ))
         shard = client_sharding(mesh)
@@ -192,7 +194,7 @@ class FedBuff:
         rngs = jax.device_put(rngs, shard)
         if frozen is not None:
             frozen = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, NamedSharding(mesh, P())),
+                lambda a: jax.device_put(a, replicated_sharding(mesh)),
                 frozen,
             )
         return cache[n_epochs](anchors, data, n_samples, rngs, frozen)

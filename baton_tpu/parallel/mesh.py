@@ -6,6 +6,9 @@ The reference's "cluster" is a dict of HTTP clients
 state, and data shards live distributed along it, the round broadcast is
 replication across it, and FedAvg is a psum over it (ICI within a host,
 DCN across hosts — XLA routes the collective).
+
+All PartitionSpecs come from :mod:`baton_tpu.parallel.partition` — this
+module only builds meshes and places arrays.
 """
 
 from __future__ import annotations
@@ -14,9 +17,13 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
-CLIENT_AXIS = "clients"
+from baton_tpu.parallel.partition import (  # noqa: F401  (re-exported)
+    CLIENT_AXIS,
+    client_spec,
+    replicated_spec,
+)
 
 
 def make_mesh(
@@ -47,14 +54,14 @@ def make_mesh(
 def client_sharding(mesh: Mesh, axis: str = CLIENT_AXIS) -> NamedSharding:
     """Sharding for ``[C, ...]`` stacked client arrays: dim 0 over the
     client mesh axis, everything else replicated."""
-    return NamedSharding(mesh, P(axis))
+    return NamedSharding(mesh, client_spec(axis))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully-replicated sharding (the global model each round —
     the TPU analogue of the reference's full-state broadcast,
     manager.py:77-86)."""
-    return NamedSharding(mesh, P())
+    return NamedSharding(mesh, replicated_spec())
 
 
 def shard_client_arrays(tree, mesh: Mesh, axis: str = CLIENT_AXIS):
@@ -69,7 +76,7 @@ def require_clients_mesh(mesh: Mesh, aggregator_spec, who: str) -> None:
     no hybrid model axis, and the mean combine rule (the sharded kernels
     aggregate with psum means; robust order statistics need the full
     stack on one device)."""
-    from baton_tpu.parallel.tensor_parallel import MODEL_AXIS
+    from baton_tpu.parallel.partition import MODEL_AXIS
 
     if MODEL_AXIS in mesh.axis_names:
         raise ValueError(

@@ -45,7 +45,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
+
+from baton_tpu.parallel.partition import dim_spec
 
 from baton_tpu.parallel.compat import pcast_varying, shard_map
 
@@ -400,8 +402,8 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS,
 
 def _seq_sharded_fn(kernel, mesh: Mesh, axis_name: str, with_bias: bool,
                     check_vma: bool = True):
-    spec = P(None, None, axis_name, None)
-    bias_spec = P(None, axis_name)  # [B, L] key bias, sharded on L
+    spec = dim_spec(axis_name, 2, 4)  # [B, H, L, Dh] sharded on L
+    bias_spec = dim_spec(axis_name, 1, 2)  # [B, L] key bias, sharded on L
 
     # check_vma=False only for the flash-ring kernel: its embedded
     # pallas_call out_shape structs carry no varying-manifest

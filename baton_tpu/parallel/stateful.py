@@ -110,9 +110,8 @@ class StatefulClients:
         sharded round)."""
         key = ("sharded", n_epochs)
         if key not in self._jit_cache:
-            from jax.sharding import PartitionSpec as P
-
             from baton_tpu.parallel.mesh import CLIENT_AXIS
+            from baton_tpu.parallel.partition import kernel_specs
 
             train_local = self._train_local(n_epochs)
 
@@ -128,12 +127,14 @@ class StatefulClients:
                                                           CLIENT_AXIS)
                 return aggregate, new_os, loss_hist, closs
 
-            self._jit_cache[key] = jax.jit(shard_map(
+            in_specs, out_specs = kernel_specs("stateful.round")
+            # donation decided no: params is the retained anchor and
+            # the optimizer-state stack is caller-threaded round state
+            self._jit_cache[key] = jax.jit(shard_map(  # batonlint: allow[BTL011]
                 kernel,
                 mesh=self.sim.mesh,
-                in_specs=(P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
-                          P(CLIENT_AXIS), P(CLIENT_AXIS)),
-                out_specs=(P(), P(CLIENT_AXIS), P(), P(CLIENT_AXIS)),
+                in_specs=in_specs,
+                out_specs=out_specs,
                 check_vma=False,
             ))
         return self._jit_cache[key]

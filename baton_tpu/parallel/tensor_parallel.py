@@ -8,77 +8,63 @@ module exists for the BASELINE configs whose models don't fit one chip
 The TPU-idiomatic mechanism is **sharding annotation, not manual
 collectives**: weights get Megatron-style ``PartitionSpec``s over a
 ``model`` mesh axis and XLA's GSPMD partitioner inserts the
-all-reduce/all-gather collectives —
-
-* column-parallel (shard the output feature dim): ``wq/wk/wv``,
-  ``w_gate/w_up``, ``w1`` (+ its bias ``b1``), ``lm_head``;
-* row-parallel (shard the input feature dim): ``wo``, ``w_down``,
-  ``w2`` — the matmul's contraction dim, whose partial sums GSPMD
-  reduces exactly where Megatron would place its all-reduce;
-* vocab-sharded embedding table ``tok_emb``; everything else (norms,
-  biases on the model dim, small heads) replicated.
+all-reduce/all-gather collectives. The per-leaf heuristics that used to
+live here are now the ``transformer-tp`` rule table in
+:mod:`baton_tpu.parallel.partition` — this module is the thin
+transformer-flavoured facade over it, kept for its established API
+(``shard_params_tp`` / ``tp_sharding_tree`` / ``leaf_tp_sharding``).
 
 This composes with the federated axes by name: a
 ``Mesh(('clients', 'model'))`` runs vmapped per-client LoRA states on
 the ``clients`` axis while the frozen base rides the ``model`` axis —
-the specs below never mention ``clients``, so GSPMD is free to
-partition the client-batched activations over it.
+the rules never mention ``clients``, so GSPMD is free to partition the
+client-batched activations over it.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from baton_tpu.core.partition import path_str
+from baton_tpu.parallel.partition import (  # noqa: F401  (MODEL_AXIS re-exported)
+    MODEL_AXIS,
+    RuleSet,
+    replicated_spec,
+    transformer_rules,
+)
 
 Params = Any
-
-MODEL_AXIS = "model"
-
-# leaf name -> (sharded_dim_kind); see module docstring for the rationale
-_COLUMN = ("wq", "wk", "wv", "w_gate", "w_up", "w1", "lm_head")
-_ROW = ("wo", "w_down", "w2")
-_COLUMN_BIAS = ("b1",)
-_VOCAB_ROWS = ("tok_emb",)
 
 
 def transformer_tp_spec(path: str, leaf, axis: str = MODEL_AXIS) -> P:
     """Megatron-style PartitionSpec for one transformer param leaf.
 
-    ``path`` is the slash-joined tree path (core/partition.py:path_str);
-    matching is on the final component, so the rules apply uniformly to
-    Llama (swiglu), BERT/ViT (gelu MLP), and LoRA-wrapped variants
-    (whose adapter leaves end in the same names under ``lora/``).
+    ``path`` is the slash-joined tree path (core/partition.py:path_str).
+    Delegates to the ``transformer-tp`` rule table — see
+    :func:`baton_tpu.parallel.partition.transformer_rules` for the
+    column/row/vocab/MoE layout rationale.
     """
-    name = path.rsplit("/", 1)[-1]
-    if leaf.ndim == 3 and name in ("w_gate", "w_up", "w_down"):
-        # stacked MoE expert weights [E, D, F]: expert parallelism
-        # shards the expert dim; GSPMD partitions the routed einsums
-        # (models/moe.py) and inserts the dispatch collectives
-        return P(axis, None, None)
-    if leaf.ndim == 2:
-        if name in _COLUMN:
-            return P(None, axis)
-        if name in _ROW:
-            return P(axis, None)
-        if name in _VOCAB_ROWS:
-            return P(axis, None)
-    if leaf.ndim == 1 and name in _COLUMN_BIAS:
-        return P(axis)
-    return P()
+    return transformer_rules(axis).spec_for(path, leaf)
 
 
-def _divisible(leaf, spec: P, mesh: Mesh) -> bool:
-    for dim, names in zip(leaf.shape, spec):
-        if names is None:
-            continue
-        if dim % mesh.shape[names]:
-            return False
-    return True
+def _rules_for(spec_fn, axis: str = MODEL_AXIS) -> Optional[RuleSet]:
+    """The RuleSet behind ``spec_fn`` when it IS the default table;
+    None for a custom callable (legacy extension point)."""
+    if spec_fn is transformer_tp_spec:
+        return transformer_rules(axis)
+    return None
+
+
+def _custom_leaf_sharding(path, leaf, mesh, spec_fn) -> NamedSharding:
+    from baton_tpu.parallel.partition import _divisible
+
+    spec = spec_fn(path, leaf)
+    if spec != replicated_spec() and not _divisible(leaf, spec, mesh):
+        spec = replicated_spec()
+    return NamedSharding(mesh, spec)
 
 
 def leaf_tp_sharding(
@@ -89,10 +75,10 @@ def leaf_tp_sharding(
 ) -> NamedSharding:
     """The TP NamedSharding for a single leaf identified by its tree
     path (with the replicated fallback for non-divisible dims)."""
-    spec = spec_fn(path, leaf)
-    if spec != P() and not _divisible(leaf, spec, mesh):
-        spec = P()
-    return NamedSharding(mesh, spec)
+    rules = _rules_for(spec_fn)
+    if rules is not None:
+        return rules.leaf_sharding(path, leaf, mesh)
+    return _custom_leaf_sharding(path, leaf, mesh, spec_fn)
 
 
 def shard_params_tp(
@@ -108,13 +94,19 @@ def shard_params_tp(
     the TP collectives. Leaves whose dims don't divide the axis size
     fall back to replicated (correct, just not sharded).
     """
+    rules = _rules_for(spec_fn, axis)
+    if rules is not None:
+        return rules.place(params, mesh)
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-    out = []
-    for path, leaf in flat:
-        spec = spec_fn(path_str(path), leaf, axis)
-        if spec != P() and not _divisible(leaf, spec, mesh):
-            spec = P()
-        out.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+    out = [
+        jax.device_put(
+            leaf,
+            _custom_leaf_sharding(
+                path_str(p), leaf, mesh, lambda pp, ll: spec_fn(pp, ll, axis)
+            ),
+        )
+        for p, leaf in flat
+    ]
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -126,24 +118,17 @@ def tp_sharding_tree(
     """The NamedSharding pytree for ``params`` — usable as jit's
     ``in_shardings``/``out_shardings`` so updated params KEEP the TP
     layout across training steps instead of decaying to replicated."""
+    rules = _rules_for(spec_fn)
+    if rules is not None:
+        return rules.shardings(params, mesh)
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-    out = []
-    for path, leaf in flat:
-        spec = spec_fn(path_str(path), leaf)
-        if spec != P() and not _divisible(leaf, spec, mesh):
-            spec = P()
-        out.append(NamedSharding(mesh, spec))
+    out = [
+        _custom_leaf_sharding(path_str(p), leaf, mesh, spec_fn)
+        for p, leaf in flat
+    ]
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def describe_tp_sharding(params: Params, mesh: Mesh) -> dict:
+def describe_tp_sharding(params: Params, mesh: Mesh) -> Dict[str, str]:
     """{path: spec-string} — introspection/debugging helper."""
-    flat = jax.tree_util.tree_flatten_with_path(params)[0]
-    out = {}
-    for path, leaf in flat:
-        p = path_str(path)
-        spec = transformer_tp_spec(p, leaf)
-        if spec != P() and not _divisible(leaf, spec, mesh):
-            spec = P()
-        out[p] = str(spec)
-    return out
+    return transformer_rules().describe(params, mesh)

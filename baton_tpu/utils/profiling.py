@@ -356,3 +356,50 @@ def fedsim_wave_hbm(device, sim, params, data, n_samples, key,
         return peak_hbm_gb(device, jitted, args)
     except Exception:
         return None, None
+
+
+def fedsim_fused_donation_plan(sim, params, data, n_samples, key,
+                               n_rounds: int = 2, n_epochs: int = 1,
+                               wave_size: Optional[int] = None) -> dict:
+    """XLA static memory plans for the fused multi-round program
+    compiled WITH and WITHOUT buffer donation — the measured answer to
+    "what does ``donate_argnums`` on the round step actually buy".
+
+    Compiles both variants (never executes); donation shows up in the
+    plan's ``alias_gb`` (the donated params/server-opt inputs alias the
+    outputs, so the globals stop being double-buffered across the
+    dispatch). Returns ``{"donate_on": breakdown, "donate_off":
+    breakdown, "delta_gb": off - on}`` with :func:`plan_breakdown_gb`
+    dicts; raises on compile failure — callers decide whether an
+    unmeasured delta is skippable (and must record why).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from baton_tpu.ops.padding import round_up
+
+    tr, fz = sim._split(params)
+    n_samples = jnp.asarray(n_samples)
+    c = int(n_samples.shape[0])
+    unit = sim._clients_per_wave_unit()
+    wave = round_up(wave_size if wave_size is not None else c, unit)
+    n_waves = -(-c // wave)
+    rngs = jax.random.split(key, c)
+    data, n_samples, _ = sim._pad_wave(data, n_samples, rngs,
+                                       n_waves * wave)
+    data_w = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a).reshape((n_waves, wave) + a.shape[1:]),
+        data,
+    )
+    n_w = n_samples.reshape(n_waves, wave)
+    sos = (sim.server_optimizer.init(tr)
+           if sim.server_optimizer is not None else None)
+    args = (tr, fz, data_w, n_w, key, sos)
+    out = {}
+    for label, donate in (("donate_on", True), ("donate_off", False)):
+        fn = sim._make_rounds_fused(n_epochs, n_rounds, donate=donate)
+        out[label] = plan_breakdown_gb(fn, args)
+    out["delta_gb"] = round(
+        out["donate_off"]["plan_gb"] - out["donate_on"]["plan_gb"], 6
+    )
+    return out

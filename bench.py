@@ -224,6 +224,25 @@ def _recorded_wave1024():
     return latest
 
 
+def _wave1024_skip_reason(platform):
+    """Why no completed wave1024 (north-star cohort) record exists — the
+    explicit evidence the SLO gate accepts in place of a number. Cites
+    the recorded hardware attempts (benchmarks/tpu_suite.py appends a
+    ``skipped`` record with the static plan when the HBM guard refuses
+    the dispatch) rather than a generic shrug."""
+    attempts = []
+    for rec in _iter_suite_records():
+        if rec.get("stage") == "wave1024" and rec.get("skipped"):
+            frag = str(rec["skipped"])
+            if isinstance(rec.get("plan_gb"), (int, float)):
+                frag += (f" (wave {rec.get('wave_size')}: "
+                         f"plan {rec['plan_gb']:.2f} GiB)")
+            attempts.append(frag)
+    if attempts:
+        return "recorded hardware attempts skipped: " + "; ".join(attempts)
+    return f"no hardware attempt recorded; bench platform={platform}"
+
+
 def _iter_jsonl_records(path):
     """Tolerantly yield dict records from a JSONL file. The suite
     appends as stages land and its premise is that the tunnel can die
@@ -570,6 +589,32 @@ def main() -> None:
         )
         log(f"fused path skipped ({fused_skip_reason})")
 
+    # --- donation on/off HBM plan delta ---
+    # XLA's static memory plan for the fused round program, compiled
+    # once with donate_argnums armed and once without: the delta is the
+    # retained input copy donation frees. XLA:CPU reports no buffer
+    # aliasing, so a CPU run records delta 0.0 — that IS the honest CPU
+    # measurement, not a probe failure.
+    donation_hbm = None
+    donation_hbm_reason = None
+    if remaining() > 30.0:
+        try:
+            from baton_tpu.utils.profiling import fedsim_fused_donation_plan
+
+            donation_hbm = fedsim_fused_donation_plan(
+                sim, p, data, n_samples, key,
+                n_rounds=min(k_f, 3), n_epochs=N_EPOCHS,
+                wave_size=wave_size)
+            log(f"donation plan: on {donation_hbm['donate_on']['plan_gb']} "
+                f"GiB / off {donation_hbm['donate_off']['plan_gb']} GiB "
+                f"(delta {donation_hbm['delta_gb']} GiB)")
+        except Exception as e:  # diagnostic stage, never the gate
+            donation_hbm_reason = f"failed: {type(e).__name__}: {e}"
+            log(f"donation plan probe failed ({type(e).__name__}: {e})")
+    else:
+        donation_hbm_reason = f"budget: {remaining():.0f}s left < 30s needed"
+        log(f"donation plan probe skipped ({donation_hbm_reason})")
+
     # --- flash-attention micro-bench: Pallas kernel vs dense einsum ---
     # The model zoo defaults to the flash kernel on TPU
     # (models/transformer.py::default_attention); this validates that the
@@ -662,6 +707,7 @@ def main() -> None:
         if batch_size != 32:
             metric += f"_b{batch_size}"
         extra = {}
+    wave1024 = _recorded_wave1024()
     print(json.dumps({
         "metric": metric,
         "value": round(best, 3),
@@ -687,9 +733,17 @@ def main() -> None:
         "dispatch_rounds_per_sec": round(rounds_per_sec, 3),
         "fused_rounds_per_sec": round(fused_rps, 3) if fused_rps else None,
         "fused_skip_reason": fused_skip_reason,
+        # the fused stage above always arms donate_buffers; the on/off
+        # comparison quantifies what that buys in the static HBM plan
+        "donation_enabled": True,
+        "donation_hbm": donation_hbm,
+        "donation_hbm_reason": donation_hbm_reason,
+        "partition_rule_set": sim.partition_rule_set,
         "attention_bench": attn_bench,
         "wave_sweep_recorded": _recorded_wave_sweep(),
-        "wave1024_recorded": _recorded_wave1024(),
+        "wave1024_recorded": wave1024,
+        "wave1024_reason": (None if wave1024
+                            else _wave1024_skip_reason(platform)),
         "flagship_mfu_recorded": _recorded_flagship_mfu(),
         **extra,
         "probe": probe_report,

@@ -1,5 +1,22 @@
-"""Diagnose WHY XLA's static memory plan overcounts the executed peak
-for the direct-conv FedSim wave kernels (TPU_EVIDENCE_r4.md).
+"""Plan + partition probes for the FedSim wave kernels.
+
+Two modes:
+
+``--specs`` — spec-equality gate for the unified partition layer.
+Rebuilds the four recorded model families (llama_tiny, llama_tiny_moe,
+bert_tiny, llama_tiny_lora), runs
+:func:`baton_tpu.parallel.partition.transformer_rules` over each param
+tree, and compares every leaf's PartitionSpec against
+``benchmarks/baselines/legacy_partition_specs.json`` — the specs the
+pre-unification ``transformer_tp_spec`` produced, recorded once before
+the per-path implementations were deleted. Any diverging leaf (or any
+leaf falling through to the unmatched-replicated fallback) is a
+regression: exits nonzero and writes the full per-leaf report to
+``--out`` (CI uploads it as the ``plan-probe`` artifact).
+
+Default (no flag) — diagnose WHY XLA's static memory plan overcounts
+the executed peak for the direct-conv FedSim wave kernels
+(TPU_EVIDENCE_r4.md).
 
 Hardware anchors on the v5e (16 GiB): the round-3 sweep EXECUTED the
 wave-64 ResNet kernel whose plan measures 17.42 GiB, while the
@@ -35,6 +52,113 @@ if _HERE not in sys.path:
     sys.path.insert(0, _HERE)
 
 
+_LEGACY_SPECS = os.path.join(
+    _REPO, "benchmarks", "baselines", "legacy_partition_specs.json"
+)
+
+
+def _family_params():
+    """The exact four param trees the legacy baseline was recorded from
+    (same tiny configs, same init key)."""
+    import jax
+
+    from baton_tpu.models.bert import BertConfig, bert_classifier_model
+    from baton_tpu.models.llama import (
+        LlamaConfig,
+        llama_lm_model,
+        llama_lora_target,
+    )
+    from baton_tpu.models.lora import lora_wrap
+    from baton_tpu.models.moe import MoEConfig
+
+    rng = jax.random.key(0)
+    return {
+        "llama_tiny": llama_lm_model(LlamaConfig.tiny()).init(rng),
+        "llama_tiny_moe": llama_lm_model(
+            LlamaConfig.tiny(moe=MoEConfig(n_experts=4, top_k=2))
+        ).init(rng),
+        "bert_tiny": bert_classifier_model(BertConfig.tiny()).init(rng),
+        "llama_tiny_lora": lora_wrap(
+            llama_lm_model(LlamaConfig.tiny()), rank=4,
+            target=llama_lora_target,
+        ).init(rng),
+    }
+
+
+def specs_report() -> dict:
+    """Compare unified-RuleSet specs against the recorded legacy specs.
+
+    Returns the full report dict; ``report["diverged"]`` is the flat
+    list of mismatches (empty == the refactor preserved every layout).
+    """
+    from baton_tpu.parallel import partition as pt
+
+    with open(_LEGACY_SPECS) as f:
+        legacy = json.load(f)
+
+    rules = pt.transformer_rules()
+    pt.reset_unmatched_leaf_count()
+    report = {
+        "baseline": os.path.relpath(_LEGACY_SPECS, _REPO),
+        "rule_set": rules.name,
+        "client_axis_spec": {
+            "legacy": legacy["client_axis_spec"],
+            "unified": str(pt.client_spec()),
+        },
+        "replicated_spec": {
+            "legacy": legacy["replicated_spec"],
+            "unified": str(pt.replicated_spec()),
+        },
+        "families": {},
+        "diverged": [],
+    }
+    for scope in ("client_axis_spec", "replicated_spec"):
+        if report[scope]["legacy"] != report[scope]["unified"]:
+            report["diverged"].append(
+                {"family": "<axis>", "path": scope, **report[scope]}
+            )
+
+    for fam, params in _family_params().items():
+        want = legacy["families"][fam]
+        got = rules.describe(params)
+        fam_rec = {"leaves": len(got), "matched": 0}
+        for path in sorted(set(want) | set(got)):
+            if want.get(path) != got.get(path):
+                report["diverged"].append({
+                    "family": fam, "path": path,
+                    "legacy": want.get(path), "unified": got.get(path),
+                })
+            else:
+                fam_rec["matched"] += 1
+        report["families"][fam] = fam_rec
+
+    report["unmatched_leaves"] = pt.unmatched_leaf_count()
+    report["ok"] = (
+        not report["diverged"] and report["unmatched_leaves"] == 0
+    )
+    return report
+
+
+def main_specs(out_path: str) -> int:
+    report = specs_report()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    total = sum(v["leaves"] for v in report["families"].values())
+    print(
+        f"plan_probe --specs: {total} leaves over "
+        f"{len(report['families'])} families, "
+        f"{len(report['diverged'])} diverged, "
+        f"{report['unmatched_leaves']} unmatched -> {out_path}",
+        flush=True,
+    )
+    for d in report["diverged"][:20]:
+        print(f"  DIVERGED {d['family']}:{d['path']}: "
+              f"legacy={d['legacy']} unified={d['unified']}")
+    return 0 if report["ok"] else 1
+
+
 def main() -> None:
     import jax
 
@@ -65,4 +189,15 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--specs", action="store_true",
+                    help="spec-equality gate vs the recorded legacy "
+                         "partition specs (exits nonzero on divergence)")
+    ap.add_argument("--out", default="artifacts/plan_probe.json",
+                    help="report path for --specs mode")
+    ns = ap.parse_args()
+    if ns.specs:
+        sys.exit(main_specs(ns.out))
     main()

@@ -555,6 +555,111 @@ def test_btl010_good_patterns_pass():
 
 
 # ----------------------------------------------------------------------
+# BTL011 — donation decision on jitted state steppers
+
+
+def test_btl011_flags_jit_without_donation_decision():
+    findings = lint(
+        """
+        import jax
+        from functools import partial
+
+        @jax.jit
+        def round_step(params, data, rng):
+            return params
+
+        @partial(jax.jit, static_argnums=(0,))
+        def train(self, params, opt_state, data):
+            return params, opt_state
+
+        def make(fn):
+            return jax.jit(fn)
+
+        def stepper(params, batch):
+            return params
+
+        stepped = jax.jit(stepper)
+        """,
+        path="baton_tpu/parallel/fixture.py",
+        rules=["BTL011"],
+    )
+    # round_step, train, and the jax.jit(stepper) call site; make(fn)
+    # is dynamic and out of scope
+    assert len(findings) == 3
+    assert all("donation decision" in f.message for f in findings)
+    assert {"round_step", "train", "stepper"} == {
+        f.message.split("`")[1] for f in findings
+    }
+
+
+def test_btl011_resolves_shard_map_wrapping():
+    findings = lint(
+        """
+        import jax
+        from baton_tpu.parallel.compat import shard_map
+
+        def kernel(params, opt_states, data, n, rngs):
+            return params
+
+        direct = jax.jit(shard_map(kernel, mesh=None))
+        bound = shard_map(kernel, mesh=None)
+        jitted = jax.jit(bound)
+        """,
+        path="baton_tpu/parallel/fixture.py",
+        rules=["BTL011"],
+    )
+    assert len(findings) == 2
+    assert all("opt_states, params" in f.message for f in findings)
+
+
+def test_btl011_good_patterns_pass():
+    findings = lint(
+        """
+        import jax
+        from functools import partial
+
+        # explicit donation
+        @partial(jax.jit, donate_argnums=(0,))
+        def fused(params, data):
+            return params
+
+        # explicit, audited "no"
+        @partial(jax.jit, donate_argnums=())
+        def wave(params, data):
+            return params
+
+        # no model-state pytree parameters: out of scope
+        @jax.jit
+        def project(x, w):
+            return x @ w
+
+        # justified suppression at the jit site
+        @jax.jit  # batonlint: allow[BTL011] — anchor re-read per wave
+        def anchored(params, data):
+            return params
+        """,
+        path="baton_tpu/parallel/fixture.py",
+        rules=["BTL011"],
+    )
+    assert findings == []
+
+
+def test_btl011_suppression_at_def_line():
+    report = Report()
+    findings = run_source(
+        "import jax\n"
+        "def step(params, data):  # batonlint: allow[BTL011]\n"
+        "    return params\n"
+        "stepped = jax.jit(step)\n",
+        path="baton_tpu/parallel/fixture.py",
+        rules=["BTL011"],
+        report=report,
+    )
+    assert findings == []
+    assert report.suppressed == 1
+
+
+# ----------------------------------------------------------------------
 # BTL020 — uncapped request-body reads
 
 
@@ -1028,8 +1133,8 @@ def test_real_metrics_registry_declares_compute_names():
 def test_all_rules_table():
     table = all_rules()
     assert set(table) == {
-        "BTL001", "BTL002", "BTL003", "BTL010", "BTL020", "BTL030",
-        "BTL031", "BTL032",
+        "BTL001", "BTL002", "BTL003", "BTL010", "BTL011", "BTL020",
+        "BTL030", "BTL031", "BTL032",
     }
     assert all(table.values())
 

@@ -106,41 +106,6 @@ def test_guards(setup):
                                aggregator="median"), n_clusters=2)
 
 
-def test_sharded_clustering_matches_single_device(setup):
-    """An IFCA round on the 8-device clients mesh equals the
-    single-device round, including with an auto-padded (non-divisible)
-    cohort."""
-    from baton_tpu.parallel.mesh import make_mesh
-
-    sim, data, n_samples, _ = setup  # 8 clients
-    sim8 = FedSim(sim.model, batch_size=32, learning_rate=0.05,
-                  mesh=make_mesh(8))
-    cf1 = ClusteredFedSim(sim, n_clusters=2)
-    cf8 = ClusteredFedSim(sim8, n_clusters=2)
-    clusters = cf1.init_clusters(jax.random.key(0))
-
-    r1 = cf1.run_round(clusters, data, n_samples, jax.random.key(1),
-                       n_epochs=2)
-    r8 = cf8.run_round(clusters, data, n_samples, jax.random.key(1),
-                       n_epochs=2)
-    np.testing.assert_array_equal(r1.assignments, r8.assignments)
-    for a, b in zip(jax.tree_util.tree_leaves(r1.cluster_params),
-                    jax.tree_util.tree_leaves(r8.cluster_params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-5, atol=2e-6)
-
-    # non-divisible: 6 clients on the 8-mesh auto-pad and still match
-    data6 = {k: v[:6] for k, v in data.items()}
-    n6 = n_samples[:6]
-    r1b = cf1.run_round(clusters, data6, n6, jax.random.key(2), n_epochs=1)
-    r8b = cf8.run_round(clusters, data6, n6, jax.random.key(2), n_epochs=1)
-    np.testing.assert_array_equal(r1b.assignments, r8b.assignments)
-    assert r8b.assignments.shape == (6,)
-    for a, b in zip(jax.tree_util.tree_leaves(r1b.cluster_params),
-                    jax.tree_util.tree_leaves(r8b.cluster_params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-5, atol=2e-6)
-
 
 def test_mesh_without_clients_axis_rejected_at_construction(setup):
     """A mesh lacking the 'clients' axis must fail with a clear error at

@@ -72,40 +72,6 @@ def test_wave_scheduling_equals_single_wave(linear_setup):
     )
 
 
-def test_sharded_round_equals_vmap_round(linear_setup):
-    model, params, data, n_samples = linear_setup
-    mesh = make_mesh(8)
-    sim_v = FedSim(model, batch_size=32, learning_rate=0.01)
-    sim_s = FedSim(model, batch_size=32, learning_rate=0.01, mesh=mesh)
-    rv = sim_v.run_round(params, data, n_samples, jax.random.key(5), n_epochs=2)
-    rs = sim_s.run_round(params, data, n_samples, jax.random.key(5), n_epochs=2)
-    np.testing.assert_allclose(
-        np.asarray(rv.params["w"]), np.asarray(rs.params["w"]), rtol=1e-4
-    )
-    np.testing.assert_allclose(
-        np.asarray(rv.loss_history), np.asarray(rs.loss_history), rtol=1e-4
-    )
-
-
-def test_sharded_round_pads_unaligned_cohort(nprng):
-    """6 clients on an 8-device mesh: phantom zero-weight clients must not
-    perturb the aggregate."""
-    model = linear_regression_model(10)
-    datasets = [linear_client_data(nprng, min_batches=2, max_batches=3) for _ in range(6)]
-    import jax.numpy as jnp
-    from baton_tpu.ops.padding import stack_client_datasets
-
-    data, n_samples = stack_client_datasets(datasets, batch_size=32)
-    data = {k: jnp.asarray(v) for k, v in data.items()}
-    n_samples = jnp.asarray(n_samples)
-    params = model.init(jax.random.key(0))
-    sim_v = FedSim(model, batch_size=32, learning_rate=0.01)
-    sim_s = FedSim(model, batch_size=32, learning_rate=0.01, mesh=make_mesh(8))
-    rv = sim_v.run_round(params, data, n_samples, jax.random.key(5), n_epochs=1)
-    rs = sim_s.run_round(params, data, n_samples, jax.random.key(5), n_epochs=1)
-    np.testing.assert_allclose(
-        np.asarray(rv.params["w"]), np.asarray(rs.params["w"]), rtol=1e-4
-    )
 
 
 def test_short_final_wave_smaller_than_pad(nprng):
@@ -248,19 +214,6 @@ def test_bad_aggregator_spec_rejected(linear_setup):
         with pytest.raises(ValueError):
             FedSim(model, aggregator=bad)
 
-
-def test_robust_aggregator_on_mesh_matches_single_device(linear_setup):
-    model, params, data, n_samples = linear_setup
-    kw = dict(batch_size=32, learning_rate=0.01, aggregator="trimmed:0.2")
-    r_one = FedSim(model, **kw).run_round(
-        params, data, n_samples, jax.random.key(5), n_epochs=1)
-    r_mesh = FedSim(model, mesh=make_mesh(8), **kw).run_round(
-        params, data, n_samples, jax.random.key(5), n_epochs=1)
-    for k in ("w", "b"):
-        np.testing.assert_allclose(
-            np.asarray(r_mesh.params[k]), np.asarray(r_one.params[k]),
-            rtol=1e-5, atol=1e-6,
-        )
 
 
 def test_evaluate_clients_fairness(linear_setup):

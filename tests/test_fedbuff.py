@@ -195,37 +195,6 @@ def test_fedbuff_rejects_server_optimizer(setup):
         FedBuff(opt_sim)
 
 
-def test_mesh_fedbuff_matches_single_device(nprng):
-    """The sharded buffer (shard_map over the clients mesh) must be the
-    same function as the single-device vmap: identical params, staleness
-    accounting, and loss history from the same seed."""
-    from baton_tpu.parallel.mesh import make_mesh
-
-    model = linear_regression_model(10)
-    datasets = [linear_client_data(nprng) for _ in range(8)]
-    data, n_samples = stack_client_datasets(datasets, batch_size=32)
-    data = {k: jnp.asarray(v) for k, v in data.items()}
-    n_samples = jnp.asarray(n_samples)
-
-    sim_1d = FedSim(model, batch_size=32, learning_rate=0.02)
-    sim_mesh = FedSim(model, batch_size=32, learning_rate=0.02,
-                      mesh=make_mesh(4))
-    params = sim_1d.init(jax.random.key(0))
-
-    out = {}
-    for name, sim in [("single", sim_1d), ("mesh", sim_mesh)]:
-        fb = FedBuff(sim, buffer_size=4, concurrency=8, alpha=0.5)
-        out[name] = fb.run(params, data, n_samples, jax.random.key(7),
-                           n_steps=6, n_epochs=2)
-    assert out["mesh"].version == out["single"].version
-    assert out["mesh"].mean_staleness == out["single"].mean_staleness
-    np.testing.assert_allclose(out["mesh"].loss_history,
-                               out["single"].loss_history, rtol=1e-5)
-    for a, b in zip(jax.tree_util.tree_leaves(out["single"].params),
-                    jax.tree_util.tree_leaves(out["mesh"].params)):
-        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5,
-                                   atol=1e-6)
-
 
 def test_mesh_fedbuff_validation(nprng):
     """Buffer must shard evenly (no phantom padding of an async buffer),

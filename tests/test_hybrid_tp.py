@@ -48,28 +48,6 @@ def _sharded_axes(x):
             for ax in ((axes,) if isinstance(axes, str) else axes)}
 
 
-def test_hybrid_round_matches_1d_mesh():
-    model, params, data, n_samples = _tiny_lora_setup()
-    kw = dict(batch_size=4, learning_rate=0.05, trainable=lora_trainable)
-
-    sim_1d = FedSim(model, mesh=make_mesh(8), **kw)
-    res_1d = sim_1d.run_round(params, data, n_samples, jax.random.key(1),
-                              n_epochs=2)
-
-    sim_h = FedSim(model, mesh=_hybrid_mesh(4, 2), **kw)
-    assert sim_h.is_hybrid and not sim_1d.is_hybrid
-    res_h = sim_h.run_round(params, data, n_samples, jax.random.key(1),
-                            n_epochs=2)
-
-    flat_1d = jax.tree_util.tree_leaves(res_1d.params)
-    flat_h = jax.tree_util.tree_leaves(res_h.params)
-    for a, b in zip(flat_1d, flat_h):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-5, atol=2e-5)
-    np.testing.assert_allclose(np.asarray(res_1d.loss_history),
-                               np.asarray(res_h.loss_history),
-                               rtol=2e-5, atol=2e-5)
-
 
 def test_hybrid_base_stays_tp_sharded():
     model, params, data, n_samples = _tiny_lora_setup()
@@ -90,25 +68,6 @@ def test_hybrid_base_stays_tp_sharded():
     some_adapter = jax.tree_util.tree_leaves(res.params["lora"])[0]
     assert "model" not in _sharded_axes(some_adapter)
 
-
-def test_hybrid_fused_rounds():
-    model, params, data, n_samples = _tiny_lora_setup()
-    kw = dict(batch_size=4, learning_rate=0.05, trainable=lora_trainable)
-
-    sim_h = FedSim(model, mesh=_hybrid_mesh(4, 2), **kw)
-    p_fused, hist_fused = sim_h.run_rounds_fused(
-        params, data, n_samples, jax.random.key(2), n_rounds=2, n_epochs=1)
-
-    sim_0 = FedSim(model, **kw)
-    p_ref, hist_ref = sim_0.run_rounds(
-        params, data, n_samples, jax.random.key(2), n_rounds=2, n_epochs=1)
-
-    np.testing.assert_allclose(np.asarray(hist_fused), np.asarray(hist_ref),
-                               rtol=2e-5, atol=2e-5)
-    for a, b in zip(jax.tree_util.tree_leaves(p_fused),
-                    jax.tree_util.tree_leaves(p_ref)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-5, atol=2e-5)
 
 
 def test_remat_matches_no_remat():

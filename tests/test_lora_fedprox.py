@@ -94,25 +94,6 @@ def test_federated_lora_trains_only_adapters(nprng):
     assert max(diffs) > 0
 
 
-def test_federated_lora_on_mesh_matches_vmap(nprng):
-    base_model = mlp_classifier_model(8, (16,), 4)
-    model = lora_wrap(base_model, rank=2)
-    params = model.init(jax.random.key(0))
-    data, n_samples = _classif_data(nprng, n_clients=8)
-    data = {k: jnp.asarray(v) for k, v in data.items()}
-    n_samples = jnp.asarray(n_samples)
-
-    sim_v = FedSim(model, batch_size=16, learning_rate=0.1,
-                   trainable=lora_trainable)
-    sim_m = FedSim(model, batch_size=16, learning_rate=0.1,
-                   trainable=lora_trainable, mesh=make_mesh(8))
-    rv = sim_v.run_round(params, data, n_samples, jax.random.key(3), n_epochs=1)
-    rm = sim_m.run_round(params, data, n_samples, jax.random.key(3), n_epochs=1)
-    for a, b in zip(jax.tree_util.tree_leaves(rv.params),
-                    jax.tree_util.tree_leaves(rm.params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-5, atol=2e-6)
-
 
 def test_fedprox_reduces_client_drift(nprng):
     model = linear_regression_model(10)

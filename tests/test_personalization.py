@@ -158,98 +158,3 @@ def test_fedper_robust_excludes_zero_sample_clients(setup, nprng):
     )
     assert moved
 
-
-def test_sharded_fedper_matches_single_device(nprng):
-    """A FedPer round on the 8-device clients mesh equals the
-    single-device round (same data/rngs): shard_map + psum aggregation
-    is numerically the same weighted mean."""
-    from baton_tpu.parallel.mesh import make_mesh
-
-    model = mlp_classifier_model(8, (16,), 4)
-    datasets, _ = _clients_with_permuted_labels(nprng, n_clients=8)
-    data, n_samples = stack_client_datasets(datasets, batch_size=16)
-    data = {k: jnp.asarray(v) for k, v in data.items()}
-    n_samples = jnp.asarray(n_samples)
-    params = FedSim(model, batch_size=16).init(jax.random.key(0))
-
-    fp1 = FedPer(FedSim(model, batch_size=16, learning_rate=0.1),
-                 personal=_head)
-    fp8 = FedPer(FedSim(model, batch_size=16, learning_rate=0.1,
-                        mesh=make_mesh(8)), personal=_head)
-    r1 = fp1.run_round(params, None, data, n_samples, jax.random.key(2),
-                       n_epochs=2)
-    r8 = fp8.run_round(params, None, data, n_samples, jax.random.key(2),
-                       n_epochs=2)
-    for a, b in zip(jax.tree_util.tree_leaves(r1.params),
-                    jax.tree_util.tree_leaves(r8.params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-5, atol=2e-6)
-    for a, b in zip(jax.tree_util.tree_leaves(r1.personal_state),
-                    jax.tree_util.tree_leaves(r8.personal_state)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-5, atol=2e-6)
-    np.testing.assert_allclose(np.asarray(r1.loss_history),
-                               np.asarray(r8.loss_history), rtol=2e-5)
-
-    # indivisible cohorts auto-pad with phantoms and still match the
-    # meshless round on the same 6 clients
-    data6 = {k: v[:6] for k, v in data.items()}
-    n6 = n_samples[:6]
-    r1b = fp1.run_round(params, None, data6, n6, jax.random.key(3),
-                        n_epochs=1)
-    r8b = fp8.run_round(params, None, data6, n6, jax.random.key(3),
-                        n_epochs=1)
-    assert jax.tree_util.tree_leaves(r8b.personal_state)[0].shape[0] == 6
-    for a, b in zip(jax.tree_util.tree_leaves(r1b.params),
-                    jax.tree_util.tree_leaves(r8b.params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-5, atol=2e-6)
-
-
-def test_sharded_fedper_with_phantom_padding_matches_unpadded(nprng):
-    """Padding a 6-client cohort to 8 with zero-sample phantoms (as the
-    divisibility error advises) must give the same result as the
-    meshless 6-client round — phantoms excluded from the warm-start
-    personal mean and weightless in the shared mean."""
-    from baton_tpu.parallel.mesh import make_mesh
-
-    model = mlp_classifier_model(8, (16,), 4)
-    datasets, _ = _clients_with_permuted_labels(nprng, n_clients=6)
-    data6, n6 = stack_client_datasets(datasets, batch_size=16)
-    data6 = {k: jnp.asarray(v) for k, v in data6.items()}
-    params = FedSim(model, batch_size=16).init(jax.random.key(0))
-
-    # pad to 8 with zero-sample phantoms
-    def pad(a):
-        z = jnp.zeros((2,) + a.shape[1:], a.dtype)
-        return jnp.concatenate([a, z], axis=0)
-
-    data8 = {k: pad(v) for k, v in data6.items()}
-    n8 = jnp.concatenate([jnp.asarray(n6), jnp.zeros(2, jnp.asarray(n6).dtype)])
-
-    fp1 = FedPer(FedSim(model, batch_size=16, learning_rate=0.1),
-                 personal=_head)
-    fp8 = FedPer(FedSim(model, batch_size=16, learning_rate=0.1,
-                        mesh=make_mesh(8)), personal=_head)
-    r1 = fp1.run_round(params, None, data6, jnp.asarray(n6),
-                       jax.random.key(2), n_epochs=2)
-    # phantoms need rng rows too: run_round splits per cohort member, so
-    # the first 6 clients see different keys than the 6-client run — use
-    # the PADDED run twice (against itself meshless) for exactness
-    fp1p = FedPer(FedSim(model, batch_size=16, learning_rate=0.1),
-                  personal=_head)
-    r1p = fp1p.run_round(params, None, data8, n8, jax.random.key(2),
-                         n_epochs=2)
-    r8 = fp8.run_round(params, None, data8, n8, jax.random.key(2),
-                       n_epochs=2)
-    for a, b in zip(jax.tree_util.tree_leaves(r1p.params),
-                    jax.tree_util.tree_leaves(r8.params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-5, atol=2e-6)
-    # and the warm-start personal leaves in params ignore the phantoms:
-    # meshless padded == meshless unpadded on the personal mean
-    p1 = jax.tree_util.tree_leaves(r1.params)
-    p1p = jax.tree_util.tree_leaves(r1p.params)
-    for a, b in zip(p1, p1p):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-5, atol=2e-6)
