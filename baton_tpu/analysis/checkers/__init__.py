@@ -9,6 +9,7 @@ observability) and add known-bad/known-good fixtures to
 """
 
 from baton_tpu.analysis.checkers import (  # noqa: F401
+    alertrules,
     blocking,
     counters,
     donation,

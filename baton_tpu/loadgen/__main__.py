@@ -44,6 +44,7 @@ def main(argv=None) -> int:
     from baton_tpu.loadgen.engine import run_scenario
     from baton_tpu.loadgen.scenario import ScenarioError, load_scenario
     from baton_tpu.loadgen.slo import evaluate_slo, write_report
+    from baton_tpu.obs.alerts import read_alerts_jsonl
     from baton_tpu.utils.slog import read_rounds_jsonl, setup_json_logging
 
     setup_json_logging(level=logging.INFO)
@@ -79,6 +80,11 @@ def main(argv=None) -> int:
     if os.path.exists(history_path):
         with open(history_path, encoding="utf-8") as fh:
             history = json.load(fh).get("history")
+    # the alert lifecycle stream backs the ``alert:*`` SLO namespace;
+    # alerting disabled → no file → [] (alert: addresses resolve to 0)
+    alerts_path = os.path.join(artifacts, "alerts.jsonl")
+    alert_events = (read_alerts_jsonl(alerts_path)[0]
+                    if os.path.exists(alerts_path) else [])
     try:
         report = evaluate_slo(
             scenario.slo, records, snapshot,
@@ -86,6 +92,7 @@ def main(argv=None) -> int:
             fleet_snapshot=fleet_snapshot,
             edge_snapshot=edge_snapshot,
             history=history,
+            alert_events=alert_events,
             n_torn=n_torn,
             exclude_rounds=summary["warmup_round_names"],
             scenario_name=scenario.name,

@@ -37,7 +37,12 @@ everything available and no faults; their round names are recorded so
 the SLO evaluator excludes them. Artifacts land in the run directory:
 ``rounds.jsonl`` (written by the manager), ``manager_metrics.json``
 (the ``/metrics`` scrape), ``loadgen_metrics.json`` (driver counters),
-``scenario_summary.json`` (phase timeline + per-round annotations).
+``scenario_summary.json`` (phase timeline + per-round annotations),
+plus — when the scenario's ``alerts`` block is enabled (the default) —
+``alerts.jsonl`` (the manager's alert lifecycle stream, backing the SLO
+evaluator's ``alert:*`` namespace), ``alerts_status.json`` (the final
+``GET /alerts`` snapshot), ``forensics_index.json`` and a
+``forensics/`` directory of content-addressed bundles.
 """
 
 from __future__ import annotations
@@ -153,6 +158,7 @@ class ScenarioRunner:
         self._edge_slots: List[_EdgeSlot] = []
         self._topology: Optional[EdgeTopology] = None
         self.rounds_path = os.path.join(artifacts_dir, "rounds.jsonl")
+        self.alerts_path = os.path.join(artifacts_dir, "alerts.jsonl")
         self._rng = random.Random(scenario.seed)
         self._nprng = np.random.default_rng(scenario.seed)
         self._slots: List[_WorkerSlot] = []
@@ -368,9 +374,11 @@ class ScenarioRunner:
     async def run(self) -> dict:
         scn = self.scenario
         os.makedirs(self.artifacts_dir, exist_ok=True)
-        # a fresh run must not inherit a previous run's rounds
+        # a fresh run must not inherit a previous run's rounds or alerts
         with contextlib.suppress(OSError):
             os.remove(self.rounds_path)
+        with contextlib.suppress(OSError):
+            os.remove(self.alerts_path)
 
         self._model = linear_regression_model(scn.model_dim)
         # ground-truth coefficients sized to the scenario's model (the
@@ -386,6 +394,20 @@ class ScenarioRunner:
         self._mport = _free_port()
         minj = FaultInjector()
         mapp = web.Application(middlewares=[minj.middleware])
+        if scn.alerts.enabled:
+            # rules=None evaluates the manager's default pack; an
+            # explicit scenario list replaces it (already validated at
+            # scenario load)
+            alerts_kwargs = dict(
+                alert_rules=(None if scn.alerts.rules is None
+                             else [dict(r) for r in scn.alerts.rules]),
+                alerts_log_path=self.alerts_path,
+                alerts_interval_s=scn.alerts.interval_s,
+                alerts_rounds_window=scn.alerts.rounds_window,
+                forensics_dir=os.path.join(self.artifacts_dir, "forensics"),
+            )
+        else:
+            alerts_kwargs = dict(alert_rules=(), alerts_interval_s=0.0)
         self._exp = Manager(mapp).register_experiment(
             self._model, name=scn.name,
             round_timeout=scn.manager.round_timeout,
@@ -395,6 +417,7 @@ class ScenarioRunner:
             ingest_workers=scn.manager.ingest_workers,
             streaming_aggregation=scn.manager.streaming_aggregation,
             rounds_log_path=self.rounds_path,
+            **alerts_kwargs,
         )
         mrunner = web.AppRunner(mapp)
         await mrunner.setup()
@@ -525,6 +548,22 @@ class ScenarioRunner:
                     fleet_health = await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError):
             pass
+        alerts_status = None
+        forensics_index = None
+        if scn.alerts.enabled:
+            try:
+                async with self._session.get(
+                    f"http://127.0.0.1:{self._mport}/{scn.name}/alerts"
+                ) as resp:
+                    if resp.status == 200:
+                        alerts_status = await resp.json()
+                async with self._session.get(
+                    f"http://127.0.0.1:{self._mport}/{scn.name}/forensics"
+                ) as resp:
+                    if resp.status == 200:
+                        forensics_index = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                pass
         loadgen_metrics = self.metrics.snapshot()
         worker_metrics = self.fleet_metrics.snapshot()
         edge_metrics = self.edge_metrics.snapshot()
@@ -559,6 +598,10 @@ class ScenarioRunner:
             self._write_json("metrics_history.json", metrics_history)
         if fleet_health is not None:
             self._write_json("fleet_health.json", fleet_health)
+        if alerts_status is not None:
+            self._write_json("alerts_status.json", alerts_status)
+        if forensics_index is not None:
+            self._write_json("forensics_index.json", forensics_index)
         self._write_json("scenario_summary.json", summary)
         return summary
 

@@ -15,8 +15,12 @@ production-traffic shape as an ordered list of **phases**, each with
 
 plus fleet-wide knobs (worker count, device-speed multipliers mapped to
 ``train_time_scale``), manager knobs (round timeout, TTL, cohort
-sampling), the open-loop round clock, and the **SLO block** the
-evaluator (:mod:`baton_tpu.loadgen.slo`) gates on.
+sampling), the open-loop round clock, the **alerts block** (the
+manager's declarative alert rules — defaults to the
+:mod:`baton_tpu.obs.alerts` pack; rules are validated at parse time so
+a typo'd rule fails the run at load, not silently at the first
+evaluation tick), and the **SLO block** the evaluator
+(:mod:`baton_tpu.loadgen.slo`) gates on.
 
 Everything here is pure config parsing + the availability math — no
 I/O beyond :func:`load_scenario`, so the curve shapes are unit-testable
@@ -34,6 +38,9 @@ import math
 import os
 import re
 from typing import Any, Dict, List, Optional, Tuple
+
+# pure-stdlib module (no jax, no server deps) — safe to import here
+from baton_tpu.obs.alerts import AlertRule, AlertRuleError
 
 
 class ScenarioError(ValueError):
@@ -415,6 +422,45 @@ class SLOSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class AlertsSpec:
+    """The manager's alerting plane for this run. ``rules: null`` (or an
+    absent block) evaluates the default pack from
+    :mod:`baton_tpu.obs.alerts`; an explicit list replaces it and every
+    rule is validated by :meth:`AlertRule.parse` **at scenario load** —
+    an unknown key or misspelled op fails the run before any socket
+    opens. ``enabled: false`` turns the evaluator off entirely."""
+
+    enabled: bool = True
+    interval_s: float = 0.25
+    rounds_window: int = 8
+    rules: Optional[Tuple[Dict[str, Any], ...]] = None
+
+    @staticmethod
+    def parse(d: Dict[str, Any]) -> "AlertsSpec":
+        ctx = "alerts"
+        f = _take(d, ctx, enabled=True, interval_s=0.25, rounds_window=8,
+                  rules=None)
+        raw_rules = f["rules"]
+        rules: Optional[Tuple[Dict[str, Any], ...]] = None
+        if raw_rules is not None:
+            if not isinstance(raw_rules, list):
+                raise ScenarioError(f"{ctx}: `rules` must be a list or null")
+            for i, rd in enumerate(raw_rules):
+                try:
+                    AlertRule.parse(rd, ctx=f"{ctx}.rules[{i}]")
+                except AlertRuleError as exc:
+                    raise ScenarioError(str(exc)) from exc
+            rules = tuple(dict(rd) for rd in raw_rules)
+        return AlertsSpec(
+            enabled=bool(f["enabled"]),
+            interval_s=_num(ctx, "interval_s", f["interval_s"], 0.01),
+            rounds_window=int(_num(ctx, "rounds_window", f["rounds_window"],
+                                   1)),
+            rules=rules,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class Scenario:
     name: str
     seed: int
@@ -425,6 +471,7 @@ class Scenario:
     phases: Tuple[PhaseSpec, ...]
     slo: SLOSpec
     edges: EdgeSpec = EdgeSpec()
+    alerts: AlertsSpec = AlertsSpec()
 
     @property
     def total_s(self) -> float:
@@ -448,7 +495,8 @@ class Scenario:
 
 def parse_scenario(d: Dict[str, Any], base_dir: str = ".") -> Scenario:
     f = _take(d, "scenario", name=None, seed=0, model=None, workers=None,
-              manager=None, rounds=None, phases=None, slo=None, edges=None)
+              manager=None, rounds=None, phases=None, slo=None, edges=None,
+              alerts=None)
     name = f["name"]
     if not isinstance(name, str) or not _NAME_RE.match(name):
         raise ScenarioError(
@@ -478,6 +526,7 @@ def parse_scenario(d: Dict[str, Any], base_dir: str = ".") -> Scenario:
         phases=phases,
         slo=SLOSpec.parse(f["slo"] or {}, base_dir),
         edges=edges,
+        alerts=AlertsSpec.parse(f["alerts"] or {}),
     )
 
 

@@ -33,6 +33,16 @@ flat ``{metric_name: float}`` namespace:
     and per-second rates over the ring's wall-clock span. These are
     NOT absence-is-zero — a run that produced no history ring (or too
     few samples for a rate) fails the assertion, same rule as timers.
+``alert:*``
+    Derived from the manager's ``alerts.jsonl`` lifecycle stream
+    (``baton_tpu.obs.alerts``): ``alert:fired:<rule>`` /
+    ``alert:resolved:<rule>`` count one rule's firing/resolved
+    transitions, ``alert:fired_total`` / ``alert:pages_fired`` sum
+    across rules, and ``alert:forensics_bundles`` counts the forensics
+    bundles captures actually produced. These are absence-is-zero like
+    counters — "the run fired no alerts" is a real, assertable zero
+    (``{"metric": "alert:fired_total", "op": "==", "value": 0}`` is the
+    quiet-fleet gate).
 ``compute:*``
     Derived from the ``compute`` section the manager folds into every
     round record (obs/compute.py): ``rounds_with_compute``,
@@ -127,7 +137,7 @@ def resolve_metric(metrics: Dict[str, float], name: str) -> Optional[float]:
     if val is not None:
         return val
     if name.startswith(("counter:", "fleet:counter:", "edge:counter:",
-                        "loadgen:")):
+                        "loadgen:", "alert:")):
         return 0.0
     return None
 
@@ -243,6 +253,37 @@ def derive_history_metrics(history: Optional[List[dict]]) -> Dict[str, float]:
         m[f"history:delta:{name}"] = delta
         if span > 0:
             m[f"history:rate:{name}"] = delta / span
+    return m
+
+
+def derive_alert_metrics(events: Optional[List[dict]]) -> Dict[str, float]:
+    """``alert:*`` metrics from the ``alerts.jsonl`` event stream.
+
+    Counts lifecycle *transitions* (one ``firing`` episode per fire, no
+    matter how long it burned) rather than sampling gauge state — a
+    flap that fired twice must read as 2, and an alert still firing at
+    run end must still count. Absence-is-zero (see module docstring):
+    with no events at all the caller still resolves every ``alert:``
+    address to 0.0."""
+    m: Dict[str, float] = {}
+    for e in events or []:
+        if not isinstance(e, dict):
+            continue
+        ev = e.get("event")
+        rule = e.get("rule")
+        if ev == "firing" and rule:
+            m[f"alert:fired:{rule}"] = m.get(f"alert:fired:{rule}", 0.0) + 1
+            m["alert:fired_total"] = m.get("alert:fired_total", 0.0) + 1
+            if e.get("severity") == "page":
+                m["alert:pages_fired"] = m.get("alert:pages_fired", 0.0) + 1
+        elif ev == "resolved" and rule:
+            m[f"alert:resolved:{rule}"] = (
+                m.get(f"alert:resolved:{rule}", 0.0) + 1
+            )
+        elif ev == "forensics":
+            m["alert:forensics_bundles"] = (
+                m.get("alert:forensics_bundles", 0.0) + 1
+            )
     return m
 
 
@@ -487,6 +528,7 @@ def evaluate_slo(
     fleet_snapshot: Optional[dict] = None,
     edge_snapshot: Optional[dict] = None,
     history: Optional[List[dict]] = None,
+    alert_events: Optional[List[dict]] = None,
     baseline: Optional[dict] = None,
     n_torn: int = 0,
     exclude_rounds: Iterable[str] = (),
@@ -505,6 +547,8 @@ def evaluate_slo(
                              fleet_snapshot, edge_snapshot)
     if history is not None:
         metrics.update(derive_history_metrics(history))
+    if alert_events is not None:
+        metrics.update(derive_alert_metrics(alert_events))
     compute_metrics, compute_skips = derive_compute_metrics(kept)
     metrics.update(compute_metrics)
     assertions = check_assertions(slo.assertions, metrics)

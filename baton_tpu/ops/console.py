@@ -17,9 +17,11 @@ Two modes:
 
 - **live** (default): clear-screen redraw every ``--interval`` seconds
   until interrupted — the operator's ``top`` for a federation.
-- **``--once --json``**: one poll, machine-readable JSON on stdout,
-  exit 0 if every polled node answered and 1 otherwise — usable as a
-  CI smoke probe (``scripts/smoke_trace.py`` runs exactly this).
+- **``--once --json``**: one poll, machine-readable JSON on stdout
+  (including each node's ``/alerts`` state), exit 0 only when every
+  polled node answered AND no ``severity: page`` alert is firing
+  anywhere in the fleet — usable as a CI smoke probe
+  (``scripts/smoke_trace.py`` runs exactly this).
 
 stdlib-only on purpose (``urllib``, no aiohttp, no asyncio): the
 console must work from any operator shell that can ``python -m``, even
@@ -39,7 +41,8 @@ import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-__all__ = ["fetch_json", "poll_node", "poll_fleet", "render", "main"]
+__all__ = ["fetch_json", "poll_node", "poll_fleet", "firing_alerts",
+           "render", "main"]
 
 #: severity order for the client table (worst first)
 _STATUS_ORDER = {"slow": 0, "flaky": 1, "degrading": 2, "healthy": 3,
@@ -78,6 +81,8 @@ def poll_node(
         "up": metrics is not None,
         "metrics": metrics,
         "health": health,
+        # alerting plane (None against a pre-alerts node — renderable)
+        "alerts": fetch_json(f"{base}/alerts", timeout_s),
     }
     if history_since is not None:
         out["history"] = fetch_json(
@@ -170,6 +175,53 @@ def _compute_line(node: dict, label: str) -> Optional[str]:
     )
 
 
+def firing_alerts(state: dict, severity: Optional[str] = None) -> List[dict]:
+    """Every firing alert across the polled fleet (root + edges),
+    optionally filtered by severity — the CI probe's page check and the
+    alert pane share this one extractor."""
+    out: List[dict] = []
+    for node in [state["root"]] + list(state["edges"]):
+        alerts = node.get("alerts") or {}
+        for rule in alerts.get("rules") or []:
+            if rule.get("state") != "firing":
+                continue
+            if severity is not None and rule.get("severity") != severity:
+                continue
+            out.append(dict(rule, node=alerts.get("node", node["url"])))
+    return out
+
+
+def _alert_pane(state: dict, paint) -> List[str]:
+    """The alert pane: firing rules first (page severity painted red),
+    then pending ones; silent when the whole fleet is quiet."""
+    lines: List[str] = []
+    rows: List[tuple] = []
+    for node in [state["root"]] + list(state["edges"]):
+        alerts = node.get("alerts") or {}
+        label = alerts.get("node", node["url"])
+        for rule in alerts.get("rules") or []:
+            if rule.get("state") in ("firing", "pending"):
+                rows.append((0 if rule["state"] == "firing" else 1,
+                             label, rule))
+    if not rows:
+        return lines
+    rows.sort(key=lambda r: (r[0], r[1], r[2].get("name", "")))
+    lines.append("  alerts:")
+    for _, label, rule in rows:
+        sev = rule.get("severity", "warn")
+        text = (
+            f"    {rule.get('state', '?').upper():<8} "
+            f"[{sev}] {label}: {rule.get('name')} "
+            f"({rule.get('metric')} {rule.get('op')} "
+            f"{rule.get('threshold')}; value={rule.get('value')}, "
+            f"episodes={rule.get('episodes', 0)})"
+        )
+        if rule.get("state") == "firing":
+            text = paint("slow" if sev == "page" else "degrading", text)
+        lines.append(text)
+    return lines
+
+
 def _client_rows(health: Optional[dict], via: str) -> List[tuple]:
     rows = []
     for cid, info in ((health or {}).get("clients") or {}).items():
@@ -228,6 +280,10 @@ def render(state: dict, color: bool = True) -> str:
             lines.append(paint("slow", "  !! recompile storm in the "
                                        "last round — check input "
                                        "shape churn"))
+
+    alert_lines = _alert_pane(state, paint)
+    if alert_lines:
+        lines.extend(alert_lines)
 
     summary = ((root.get("health") or {}).get("summary")) or {}
     if summary:
@@ -325,7 +381,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
             print(render(state, color=sys.stdout.isatty()))
         if args.once:
-            return 0 if all_up else 1
+            # the CI probe fails on a dead node OR a firing page-severity
+            # alert anywhere in the fleet — liveness alone is not health
+            pages = firing_alerts(state, severity="page")
+            if pages and not args.as_json:
+                for rule in pages:
+                    print(f"PAGE firing: {rule.get('node')}: "
+                          f"{rule.get('name')}")
+            return 0 if (all_up and not pages) else 1
         try:
             time.sleep(max(0.2, args.interval))
         except KeyboardInterrupt:

@@ -57,6 +57,7 @@ import aiohttp
 from aiohttp import web
 import numpy as np
 
+from baton_tpu.obs import alerts as obs_alerts
 from baton_tpu.ops.aggregation import StreamingMean
 from baton_tpu.server import wire
 from baton_tpu.server.blobs import BlobStore
@@ -184,6 +185,9 @@ class EdgeAggregator:
         clients_log_path: Optional[str] = None,
         health_window: int = 32,
         metrics_history_interval_s: float = 5.0,
+        alert_rules: Optional[list] = None,
+        alerts_log_path: Optional[str] = None,
+        alerts_interval_s: float = 1.0,
         auto_start: bool = True,
     ) -> None:
         self.name = name
@@ -208,6 +212,19 @@ class EdgeAggregator:
         )
         self.metrics_history_interval_s = float(metrics_history_interval_s)
         self._history_task: Optional[PeriodicTask] = None
+        # alerting plane, edge vantage: the same declarative engine the
+        # root runs, over this edge's own metric namespace (rules that
+        # select rounds.* series simply skip here — the edge keeps no
+        # rounds.jsonl tail). No forensics on edges: the deep-capture
+        # evidence (profiler, round trace) lives at the root.
+        self.alerts_interval_s = float(alerts_interval_s)
+        self.alerts = obs_alerts.AlertEngine(
+            alert_rules,
+            log_path=alerts_log_path,
+            metrics=self.metrics,
+            node=f"edge:{self.edge_name}",
+        )
+        self._alerts_task: Optional[PeriodicTask] = None
         self._last_ship_s: Optional[float] = None
         self._pipe = IngestPipeline(
             workers=ingest_workers, queue_depth=ingest_queue_depth,
@@ -255,6 +272,7 @@ class EdgeAggregator:
             f"/{self.name}/metrics/history", self.handle_metrics_history
         )
         r.add_get(f"/{self.name}/fleet/health", self.handle_fleet_health)
+        r.add_get(f"/{self.name}/alerts", self.handle_alerts)
         if auto_start:
             app.on_startup.append(self._on_startup)
             app.on_cleanup.append(self._on_cleanup)
@@ -269,10 +287,26 @@ class EdgeAggregator:
             self._history_task = PeriodicTask(
                 self._history_tick, self.metrics_history_interval_s
             ).start()
+        if self.alerts.rules and self.alerts_interval_s > 0:
+            self._alerts_task = PeriodicTask(
+                self._alerts_tick, self.alerts_interval_s
+            ).start()
 
     async def _history_tick(self) -> None:
         self.fleet.export_gauges(self.metrics)
         self.metrics.record_history()
+
+    async def _alerts_tick(self) -> None:
+        # advisory plane: a failed evaluation is counted, never raised
+        try:
+            self.fleet.export_gauges(self.metrics)
+            view = obs_alerts.build_metric_view(self.metrics.snapshot())
+            self.alerts.evaluate(view, history=self.metrics.history())
+        except Exception:
+            self.metrics.inc("alerts_eval_errors")
+            logging.getLogger(__name__).exception(
+                "%s: edge alert evaluation tick failed", self.edge_name
+            )
 
     async def _on_cleanup(self, app=None) -> None:
         self._closed = True
@@ -280,6 +314,8 @@ class EdgeAggregator:
             await self._heartbeat_task.stop()
         if self._history_task is not None:
             await self._history_task.stop()
+        if self._alerts_task is not None:
+            await self._alerts_task.stop()
         r = self._round
         if r is not None:
             r.cancel_tasks()
@@ -1317,3 +1353,8 @@ class EdgeAggregator:
         self, request: web.Request
     ) -> web.Response:
         return web.json_response(json_clean(self.fleet.health_snapshot()))
+
+    async def handle_alerts(self, request: web.Request) -> web.Response:
+        """``GET /{name}/alerts`` — this edge's rule states (same
+        payload shape as the root's endpoint)."""
+        return web.json_response(json_clean(self.alerts.status_snapshot()))

@@ -424,6 +424,28 @@ class ClientLedger:
             "clients": clients,
         }
 
+    def health_slice(self, client_ids=None) -> dict:
+        """The forensics-bundle fleet evidence: classifications for the
+        implicated clients only (the round's stragglers), or — with no
+        ids — every client currently classified non-healthy. Bounded so
+        a bundle never embeds a 10k-client ledger dump."""
+        clients = self.classify_all()
+        if client_ids is not None:
+            picked = {cid: clients[cid] for cid in client_ids
+                      if cid in clients}
+            unknown = sorted(set(client_ids) - set(clients))
+        else:
+            picked = {cid: info for cid, info in clients.items()
+                      if info["status"] != "healthy"}
+            unknown = []
+        return {
+            "node": self.node,
+            "window": self.window,
+            "implicated": sorted(picked),
+            "unknown": unknown,
+            "clients": picked,
+        }
+
     # ------------------------------------------------------------------
     def known_clients(self) -> List[str]:
         with self._lock:

@@ -80,7 +80,9 @@ import logging
 import math
 import os
 import re
+import tempfile
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 import aiohttp
@@ -90,7 +92,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from baton_tpu.core.model import FedModel
+from baton_tpu.obs import alerts as obs_alerts
 from baton_tpu.obs import compute as obs_compute
+from baton_tpu.obs import forensics as obs_forensics
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.server import wire
 from baton_tpu.server.blobs import BlobStore
@@ -107,9 +111,9 @@ from baton_tpu.server.utils import (
     read_body_capped,
     read_json_capped,
 )
-from baton_tpu.utils import tracing
+from baton_tpu.utils import profiling, tracing
 from baton_tpu.utils.metrics import LoopLagProbe, Metrics
-from baton_tpu.utils.slog import RoundsLog
+from baton_tpu.utils.slog import RoundsLog, maybe_rotate_jsonl
 from baton_tpu.utils.tracing import trace_headers
 
 DEFAULT_N_EPOCH = 32  # reference manager.py:52-55
@@ -273,6 +277,16 @@ class Experiment:
         clients_log_path: Optional[str] = None,
         health_window: int = 32,
         metrics_history_interval_s: float = 5.0,
+        alert_rules: Optional[list] = None,
+        alerts_log_path: Optional[str] = None,
+        alerts_interval_s: float = 1.0,
+        alerts_rounds_window: int = 8,
+        forensics_dir: Optional[str] = None,
+        forensics_max_bundles: int = 16,
+        retention_interval_s: float = 60.0,
+        trace_spool_max_age_s: float = 3600.0,
+        trace_spool_max_files: int = 512,
+        jsonl_max_bytes: Optional[int] = 64 * 1024 * 1024,
     ):
         """``aggregator``: ``"mean"`` (sample-weighted FedAvg, reference
         manager.py:119-126), or Byzantine-robust ``"trimmed:<ratio>"`` /
@@ -390,7 +404,28 @@ class Experiment:
 
         ``metrics_history_interval_s``: period of the background task
         that snapshots the metrics registry into the bounded history
-        ring behind ``GET /{name}/metrics/history`` (0 disables it)."""
+        ring behind ``GET /{name}/metrics/history`` (0 disables it).
+
+        ``alert_rules``: declarative alert rule pack
+        (:mod:`baton_tpu.obs.alerts`) evaluated every
+        ``alerts_interval_s`` against this node's metric namespace, the
+        metrics-history ring, and the last ``alerts_rounds_window``
+        round records. ``None`` means the default pack; ``[]`` disables
+        evaluation (the ``GET /{name}/alerts`` endpoint stays up).
+        Lifecycle transitions append to ``alerts_log_path``
+        (``alerts.jsonl``, same crash-safe discipline as
+        ``rounds.jsonl``). Rules marked ``capture: true`` arm a
+        forensics bundle for the next finished round, stored
+        content-addressed under ``forensics_dir`` (in-memory-only when
+        unset) and served at ``GET /{name}/forensics/{digest}``; at
+        most ``forensics_max_bundles`` are retained.
+
+        Retention: every ``retention_interval_s`` a background task
+        GCs the trace spool (age ``trace_spool_max_age_s`` / count
+        ``trace_spool_max_files``, exempting traces referenced by
+        retained forensics bundles) and rotates ``rounds.jsonl`` /
+        ``clients.jsonl`` once they exceed ``jsonl_max_bytes``
+        (``None`` disables rotation)."""
         if secure_agg and allow_pickle:
             raise ValueError(
                 "secure_agg is incompatible with allow_pickle: reference-"
@@ -522,6 +557,36 @@ class Experiment:
             node="manager",
         )
         self.metrics_history_interval_s = float(metrics_history_interval_s)
+        # alerting plane (obs/alerts.py): rules evaluated on a periodic
+        # tick against the metric view; capture-flagged rules arm a
+        # forensics bundle for the next round. Advisory, like the fleet
+        # ledger — nothing here may break round completion.
+        self.alerts_interval_s = float(alerts_interval_s)
+        self.clients_log_path = clients_log_path
+        self.retention_interval_s = float(retention_interval_s)
+        self.trace_spool_max_age_s = float(trace_spool_max_age_s)
+        self.trace_spool_max_files = int(trace_spool_max_files)
+        self.jsonl_max_bytes = (
+            None if jsonl_max_bytes is None else int(jsonl_max_bytes)
+        )
+        # mirror of appended rounds.jsonl records: the alert evaluator
+        # derives its rounds.* series from this deque so an evaluation
+        # tick never does blocking file IO on the loop
+        self._recent_rounds: deque = deque(maxlen=64)
+        self.forensics = obs_forensics.ForensicsStore(
+            forensics_dir, max_bundles=forensics_max_bundles
+        )
+        # the pending capture armed by a firing capture:true rule —
+        # consumed by the next _finish_round_obs
+        self._forensics_armed: Optional[dict] = None
+        self.alerts = obs_alerts.AlertEngine(
+            alert_rules,
+            log_path=alerts_log_path,
+            metrics=self.metrics,
+            node="manager",
+            rounds_window=alerts_rounds_window,
+            on_capture=self._arm_forensics,
+        )
         # the notify fan-out of the round in flight (participation
         # denominator for the ledger's missed-round accounting)
         self._round_cohort: list = []
@@ -753,6 +818,20 @@ class Experiment:
                 self._watchdog_tick, max(self.rounds.round_timeout / 4, 0.25)
             )
             self._background.append(watchdog.start())
+        if self.alerts.rules and self.alerts_interval_s > 0:
+            alerts_task = PeriodicTask(
+                self._alerts_tick, self.alerts_interval_s
+            )
+            self._background.append(alerts_task.start())
+        if self.retention_interval_s > 0 and (
+            self.tracer.spool_dir
+            or (self.jsonl_max_bytes is not None
+                and (self.rounds_log is not None or self.clients_log_path))
+        ):
+            retention = PeriodicTask(
+                self._retention_tick, self.retention_interval_s
+            )
+            self._background.append(retention.start())
         if self._recovered_round is not None:
             self._recovery_task = asyncio.get_running_loop().create_task(
                 self._resume_round()
@@ -791,6 +870,49 @@ class Experiment:
         # included) so a history entry equals what /metrics would have
         # answered at that instant
         self.metrics.record_history(snapshot=self.metrics_snapshot())
+
+    async def _alerts_tick(self) -> None:
+        # advisory plane: any failure is logged and counted, never
+        # propagated — same contract as the fleet ledger
+        try:
+            view = obs_alerts.build_metric_view(
+                self.metrics_snapshot(),
+                list(self._recent_rounds),
+                self.alerts.rounds_window,
+            )
+            self.alerts.evaluate(view, history=self.metrics.history())
+        except Exception:
+            self.metrics.inc("alerts_eval_errors")
+            _log.exception("%s: alert evaluation tick failed", self.name)
+
+    async def _retention_tick(self) -> None:
+        """Bound the on-disk observability artifacts: trace-spool GC
+        (exempting traces that retained forensics bundles reference) and
+        size-based rotation of ``rounds.jsonl`` / ``clients.jsonl``
+        (their readers are torn-line-tolerant). All file IO off-loop."""
+        if self.tracer.spool_dir:
+            removed = await asyncio.to_thread(
+                tracing.gc_spool,
+                self.tracer.spool_dir,
+                max_age_s=self.trace_spool_max_age_s,
+                max_files=self.trace_spool_max_files,
+                exempt=self.forensics.referenced_trace_ids(),
+            )
+            if removed:
+                self.metrics.inc("trace_spool_gc_removed", removed)
+        if self.jsonl_max_bytes is None:
+            return
+        if self.rounds_log is not None:
+            if await asyncio.to_thread(
+                self.rounds_log.maybe_rotate, self.jsonl_max_bytes
+            ):
+                self.metrics.inc("jsonl_rotations")
+        if self.clients_log_path:
+            if await asyncio.to_thread(
+                maybe_rotate_jsonl, self.clients_log_path,
+                self.jsonl_max_bytes,
+            ):
+                self.metrics.inc("jsonl_rotations")
 
     async def _watchdog_tick(self) -> None:
         if self._broadcasting:
@@ -835,6 +957,13 @@ class Experiment:
             f"/{self.name}/metrics/history", self.handle_metrics_history
         )
         r.add_get(f"/{self.name}/fleet/health", self.handle_fleet_health)
+        # alerting plane: rule states + firing/pending lists; forensics
+        # bundles by content digest
+        r.add_get(f"/{self.name}/alerts", self.handle_alerts)
+        r.add_get(f"/{self.name}/forensics", self.handle_forensics_index)
+        r.add_get(
+            f"/{self.name}/forensics/{{digest}}", self.handle_forensics
+        )
         r.add_get(
             f"/{self.name}/round_blob/{{digest}}", self.handle_round_blob
         )
@@ -1012,6 +1141,111 @@ class Experiment:
         + advisory anomaly classifications (server/fleet.py)."""
         return web.json_response(json_clean(self.fleet.health_snapshot()))
 
+    # -- alerting plane ------------------------------------------------
+    async def handle_alerts(self, request: web.Request) -> web.Response:
+        """``GET /{name}/alerts`` — every rule's lifecycle state, last
+        value, and recent transitions, plus the firing/pending lists."""
+        return web.json_response(json_clean(self.alerts.status_snapshot()))
+
+    async def handle_forensics_index(
+        self, request: web.Request
+    ) -> web.Response:
+        return web.json_response(
+            json_clean({"bundles": self.forensics.list_bundles()})
+        )
+
+    async def handle_forensics(self, request: web.Request) -> web.Response:
+        """``GET /{name}/forensics/{digest}`` — one content-addressed
+        bundle manifest with its evidence sections inline."""
+        bundle = self.forensics.get(request.match_info["digest"])
+        if bundle is None:
+            return web.json_response({"err": "Unknown Bundle"}, status=404)
+        return web.json_response(json_clean(bundle))
+
+    def _arm_forensics(self, rule, event: dict) -> None:
+        """``on_capture`` hook: a capture-flagged rule fired — arm a
+        bundle for the next finished round, and arm the one-shot
+        ``jax.profiler`` capture that the next training step consumes
+        (graceful no-op off-TPU / when no step runs while armed)."""
+        base = self.forensics.dir_path or tempfile.gettempdir()
+        profile_dir = os.path.join(
+            base, f"forensics_profile_{self.name}_{rule.name}"
+        )
+        profiling.arm_forensics_trace(profile_dir)
+        self._forensics_armed = {
+            "rule": rule.name,
+            "severity": rule.severity,
+            "armed_ts": float(event.get("ts") or time.time()),
+            "profile_dir": profile_dir,
+        }
+
+    def _build_forensics_bundle(self, record: dict) -> None:
+        """Package the armed capture against the round that just
+        finished: every evidence section present or null-with-reason
+        (:mod:`baton_tpu.obs.forensics`)."""
+        armed, self._forensics_armed = self._forensics_armed, None
+        if armed is None:
+            return
+        sections: Dict[str, Any] = {}
+        reasons: Dict[str, str] = {}
+        snap = self.metrics.snapshot()
+        sections["jax_profile"] = obs_forensics.profile_dir_summary(
+            armed.get("profile_dir")
+        )
+        if sections["jax_profile"] is None:
+            reasons["jax_profile"] = (
+                "profiler produced no artifacts (off-TPU no-op, or no "
+                "training step ran while armed)"
+            )
+        try:
+            sections["task_stacks"] = obs_forensics.dump_asyncio_tasks()
+        except Exception as exc:
+            reasons["task_stacks"] = obs_forensics.safe_repr_exc(exc)
+        lag = (snap.get("timers") or {}).get("loop_lag_s")
+        if lag is not None:
+            sections["loop_lag"] = lag
+        try:
+            stragglers = record.get("stragglers") or None
+            sections["fleet_slice"] = self.fleet.health_slice(stragglers)
+        except Exception as exc:
+            reasons["fleet_slice"] = obs_forensics.safe_repr_exc(exc)
+        trace_id = record.get("trace_id")
+        try:
+            export = self.tracer.export(trace_id) if trace_id else None
+            if export and export.get("traceEvents"):
+                sections["round_trace"] = export
+        except Exception as exc:
+            reasons["round_trace"] = obs_forensics.safe_repr_exc(exc)
+        history = self.metrics.history()
+        if history:
+            sections["metric_history"] = history[-32:]
+        manifest = obs_forensics.build_manifest(
+            rule=armed["rule"],
+            severity=armed["severity"],
+            round_name=record.get("round"),
+            trace_id=trace_id,
+            node="manager",
+            armed_ts=armed["armed_ts"],
+            captured_ts=time.time(),
+            sections=sections,
+            reasons=reasons,
+        )
+        digest = self.forensics.put(manifest)
+        self.metrics.inc("alerts_captures_built")
+        self.alerts.log_event({
+            "ts": round(time.time(), 6),
+            "event": "forensics",
+            "rule": armed["rule"],
+            "severity": armed["severity"],
+            "round": record.get("round"),
+            "digest": digest,
+            "sections_present": manifest["sections_present"],
+        })
+        _log.info(
+            "%s: forensics bundle %s captured for rule %s (round %s)",
+            self.name, digest, armed["rule"], record.get("round"),
+        )
+
     # -- distributed tracing -------------------------------------------
     def _round_trace_id(self, rid: str) -> str:
         """A trace id from either a full round name or a bare round
@@ -1099,8 +1333,6 @@ class Experiment:
         except Exception:
             _log.exception("%s: fleet ledger record failed", self.name)
             straggler_why = {}
-        if self.rounds_log is None:
-            return
         responses = responses or {}
         participants = sorted(participants)
         reporters = sorted(responses)
@@ -1151,7 +1383,7 @@ class Experiment:
                 "compute_compile_s", float(cs),
                 exemplar=(trace_id, tracing.root_span_id(trace_id)),
             )
-        self.rounds_log.append({
+        record = {
             "round": round_name,
             "round_index": self.rounds.n_rounds,
             "trace_id": trace_id,
@@ -1167,7 +1399,22 @@ class Experiment:
             "counters_delta": deltas,
             "phase_s": phases,
             "compute": compute_section,
-        })
+        }
+        # mirrored for the alert evaluator's rounds.* tail (no file IO
+        # on an evaluation tick) — kept even when rounds_log is off
+        self._recent_rounds.append(record)
+        if self.rounds_log is not None:
+            self.rounds_log.append(record)
+        if self._forensics_armed is not None:
+            # forensics is advisory: a broken capture must never break
+            # round completion (same contract as the fleet ledger)
+            try:
+                self._build_forensics_bundle(record)
+            except Exception:
+                self.metrics.inc("alerts_eval_errors")
+                _log.exception(
+                    "%s: forensics bundle capture failed", self.name
+                )
 
     def _new_stream_acc(self):
         """The round's streaming accumulator: sequential (deterministic)

@@ -72,7 +72,7 @@ from baton_tpu.server.utils import (
     read_body_capped,
     read_json_capped,
 )
-from baton_tpu.utils import tracing
+from baton_tpu.utils import profiling, tracing
 from baton_tpu.utils.metrics import Metrics
 from baton_tpu.utils.tracing import trace_headers
 
@@ -1206,9 +1206,14 @@ class ExperimentWorker:
                         int(n_epoch),
                     )
                     steps = None
-                params, _, losses = self.trainer.train(
-                    self.params, padded, np.int32(n_samples), sub, n_epoch
-                )
+                # forensics: when a capture:true alert armed a one-shot
+                # profiler capture, this step consumes it (no-op when
+                # unarmed; jax.profiler failures are swallowed inside)
+                with profiling.forensics_trace():
+                    params, _, losses = self.trainer.train(
+                        self.params, padded, np.int32(n_samples), sub,
+                        n_epoch
+                    )
                 return params, np.asarray(losses), sig, steps
 
             # explicit derived trace id: under a live traceparent

@@ -139,6 +139,15 @@ DECLARED_COUNTERS = frozenset({
     "edge_phase_blob_fetch_s",
     "edge_phase_settle_s",
     "edge_phase_ship_prev_s",
+    # alerting plane (baton_tpu/obs/alerts.py engine, per node)
+    "alerts_fired_total",
+    "alerts_resolved_total",
+    "alerts_eval_errors",
+    "alerts_captures_armed",
+    "alerts_captures_built",   # manager: forensics bundles materialized
+    # retention (trace-spool GC + jsonl rotation PeriodicTasks)
+    "trace_spool_gc_removed",
+    "jsonl_rotations",
 })
 
 DECLARED_COUNTER_PREFIXES = (
@@ -215,6 +224,9 @@ DECLARED_GAUGES = frozenset({
     "fleet_clients_flaky",
     "fleet_clients_degrading",
     "fleet_clients_inactive",
+    # alerting plane: current rule-state counts (obs/alerts.py engine)
+    "alerts_firing",
+    "alerts_pending",
     # compute plane (baton_tpu/obs/compute.py probe records; latest round)
     "compute_mfu",
     "compute_samples_per_sec_per_chip",

@@ -37,6 +37,62 @@ def profile_trace(log_dir: Optional[str] = None):
         yield
 
 
+# ---------------------------------------------------------------------------
+# Forensics arming: the alerting plane (obs/alerts.py) arms a one-shot
+# profiler capture when a `capture: true` rule fires; the NEXT training
+# step that reaches a `forensics_trace()` call site consumes the arm and
+# traces itself into the armed directory. Consume-once under a lock so
+# an alert storm cannot stack traces, and every jax.profiler failure is
+# swallowed — forensics is advisory, it must never break the step.
+
+import threading as _threading
+
+_FORENSICS_LOCK = _threading.Lock()
+_FORENSICS_DIR: Optional[str] = None
+
+
+def arm_forensics_trace(log_dir: str) -> None:
+    """Arm the next :func:`forensics_trace` call site to capture a
+    ``jax.profiler`` trace into ``log_dir``. Re-arming before the
+    previous arm is consumed just re-points the directory."""
+    global _FORENSICS_DIR
+    with _FORENSICS_LOCK:
+        _FORENSICS_DIR = log_dir
+
+
+def forensics_armed() -> bool:
+    with _FORENSICS_LOCK:
+        return _FORENSICS_DIR is not None
+
+
+@contextmanager
+def forensics_trace():
+    """Consume a pending forensics arm around the enclosed block,
+    yielding the trace directory (or None when unarmed / the profiler
+    refused to start). Graceful no-op off-TPU and on profiler errors."""
+    global _FORENSICS_DIR
+    with _FORENSICS_LOCK:
+        log_dir, _FORENSICS_DIR = _FORENSICS_DIR, None
+    if not log_dir:
+        yield None
+        return
+    trace = None
+    try:
+        os.makedirs(log_dir, exist_ok=True)
+        trace = jax.profiler.trace(log_dir)
+        trace.__enter__()
+    except Exception:
+        trace = None
+    try:
+        yield log_dir if trace is not None else None
+    finally:
+        if trace is not None:
+            try:
+                trace.__exit__(None, None, None)
+            except Exception:
+                pass
+
+
 def annotate(name: str):
     """Named trace region (``jax.profiler.TraceAnnotation``); nullcontext
     if the profiler lacks it (old jax)."""

@@ -107,6 +107,36 @@ class RoundsLog:
         :func:`read_rounds_jsonl` when the torn-line count matters."""
         return read_rounds_jsonl(self.path)[0]
 
+    def maybe_rotate(self, max_bytes: int) -> bool:
+        """Size-bounded rotation under the appender's own lock: when the
+        file exceeds ``max_bytes`` it moves to ``<path>.1`` (replacing
+        any previous rotation) and appends restart fresh. One
+        generation is enough — readers are torn-line-tolerant and the
+        SLO harness consumes the live file within a run."""
+        return maybe_rotate_jsonl(self.path, max_bytes, lock=self._lock)
+
+
+def maybe_rotate_jsonl(
+    path: str,
+    max_bytes: int,
+    lock: Optional[threading.Lock] = None,
+) -> bool:
+    """Rotate ``path`` to ``path.1`` when it exceeds ``max_bytes``
+    (``os.replace`` — atomic on POSIX). Returns True when a rotation
+    happened. Advisory: every OS error is swallowed, a retention tick
+    must never take its owner down."""
+    if not max_bytes or max_bytes <= 0:
+        return False
+    ctx = lock if lock is not None else threading.Lock()
+    with ctx:
+        try:
+            if os.path.getsize(path) <= max_bytes:
+                return False
+            os.replace(path, path + ".1")
+            return True
+        except OSError:
+            return False
+
 
 def read_rounds_jsonl(path: str) -> tuple:
     """Tolerant ``rounds.jsonl`` reader: returns ``(records, n_torn)``.

@@ -391,3 +391,60 @@ class Tracer:
                 out = self._spans.pop(trace_id, [])
             self._n_spans -= len(out)
         return out
+
+
+def gc_spool(
+    spool_dir: Optional[str],
+    *,
+    max_age_s: float = 3600.0,
+    max_files: int = 512,
+    exempt=(),
+    now: Optional[float] = None,
+) -> int:
+    """Bound the span spool: the spool grows one ``.jsonl`` per trace
+    forever, so a retention tick deletes files older than ``max_age_s``
+    (by mtime) and then the oldest beyond ``max_files`` — except traces
+    in ``exempt`` (ids a retained forensics bundle still references;
+    deleting those would hollow out served evidence). Returns the number
+    of files removed; every error is ignored, retention is advisory."""
+    if not spool_dir or not os.path.isdir(spool_dir):
+        return 0
+    now = time.time() if now is None else now
+    exempt = set(exempt)
+    entries = []  # (mtime, path, trace_id)
+    try:
+        names = os.listdir(spool_dir)
+    except OSError:
+        return 0
+    for name in names:
+        if not name.endswith(".jsonl"):
+            continue
+        trace_id = name[:-len(".jsonl")]
+        if trace_id in exempt:
+            continue
+        path = os.path.join(spool_dir, name)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue
+        entries.append((mtime, path, trace_id))
+    entries.sort()  # oldest first
+    removed = 0
+    keep = []
+    for mtime, path, trace_id in entries:
+        if max_age_s is not None and now - mtime > max_age_s:
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
+        else:
+            keep.append(path)
+    excess = len(keep) - max(0, int(max_files))
+    for path in keep[:max(0, excess)]:
+        try:
+            os.remove(path)
+            removed += 1
+        except OSError:
+            pass
+    return removed

@@ -1,0 +1,812 @@
+"""Alerting plane + forensics bundles: rule parsing, the
+pending→firing→resolved lifecycle against a fake clock (for_s holds,
+cooldowns, hysteresis, burn-rate pairs, eval-failure isolation),
+manifest null-with-reason + content addressing + store bounds, the
+retention satellites (trace-spool GC, JSONL rotation), the loadgen
+``alert:*`` namespace, the ops-console alert pane — and two e2e
+federations over real sockets: an induced straggler phase that fires
+the default ``straggler_rate`` page and materializes a forensics
+bundle, and a quiet fleet that fires nothing over five rounds.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from baton_tpu.core.training import make_local_trainer
+from baton_tpu.data.synthetic import linear_client_data
+from baton_tpu.loadgen.scenario import ScenarioError, parse_scenario
+from baton_tpu.loadgen.slo import derive_alert_metrics, resolve_metric
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.obs import forensics
+from baton_tpu.obs.alerts import (
+    AlertEngine,
+    AlertRule,
+    AlertRuleError,
+    DEFAULT_RULES,
+    build_metric_view,
+    derive_rounds_tail,
+    read_alerts_jsonl,
+    resolve_view_metric,
+    windowed_rate,
+)
+from baton_tpu.ops import console
+from baton_tpu.server.edge import EdgeAggregator
+from baton_tpu.server.http_manager import Manager
+from baton_tpu.server.http_worker import ExperimentWorker
+from baton_tpu.utils.faults import FaultInjector
+from baton_tpu.utils.metrics import Metrics
+from baton_tpu.utils.slog import maybe_rotate_jsonl
+from baton_tpu.utils.tracing import gc_spool
+
+
+# ----------------------------------------------------------------------
+# rule parsing
+
+
+def test_rule_parse_rejects_unknown_key():
+    with pytest.raises(AlertRuleError, match="treshold"):
+        AlertRule.parse({"name": "r", "metric": "counter:x",
+                         "treshold": 1})
+
+
+def test_rule_parse_threshold_xor_burn_rate():
+    with pytest.raises(AlertRuleError, match="exactly one"):
+        AlertRule.parse({"name": "r", "metric": "counter:x"})
+    with pytest.raises(AlertRuleError, match="exactly one"):
+        AlertRule.parse({
+            "name": "r", "metric": "counter:x", "threshold": 1,
+            "burn_rate": {"short_s": 1, "long_s": 2, "threshold": 1},
+        })
+
+
+def test_rule_parse_burn_rate_shape_and_counter_only():
+    with pytest.raises(AlertRuleError, match="short_s"):
+        AlertRule.parse({"name": "r", "metric": "counter:x",
+                         "burn_rate": {"long_s": 2, "threshold": 1}})
+    with pytest.raises(AlertRuleError, match="must be < long_s"):
+        AlertRule.parse({"name": "r", "metric": "counter:x",
+                         "burn_rate": {"short_s": 5, "long_s": 2,
+                                       "threshold": 1}})
+    with pytest.raises(AlertRuleError, match="counter:"):
+        AlertRule.parse({"name": "r", "metric": "timer:round_s:p95",
+                         "burn_rate": {"short_s": 1, "long_s": 2,
+                                       "threshold": 1}})
+
+
+def test_engine_rejects_duplicate_rule_names():
+    rule = {"name": "dup", "metric": "counter:x", "threshold": 1}
+    with pytest.raises(AlertRuleError, match="duplicate"):
+        AlertEngine([rule, dict(rule)])
+
+
+def test_default_rules_all_parse():
+    engine = AlertEngine()
+    assert [r.name for r in engine.rules] == [
+        d["name"] for d in DEFAULT_RULES
+    ]
+
+
+# ----------------------------------------------------------------------
+# the metric view
+
+
+def test_resolve_view_metric_counter_absence_is_zero():
+    view = {"counter:a": 3.0}
+    assert resolve_view_metric(view, "counter:a") == (3.0, None)
+    assert resolve_view_metric(view, "counter:never") == (0.0, None)
+    val, why = resolve_view_metric(view, "timer:round_s:p95")
+    assert val is None and "not present" in why
+
+
+def test_build_metric_view_flattens_snapshot_and_tail():
+    m = Metrics()
+    m.inc("updates_received", 4)
+    m.set_gauge("alerts_firing", 1)
+    m.observe("round_s", 0.5)
+    tail = [{"participants": 4, "stragglers": ["w3"],
+             "outcome": "completed", "duration_s": 1.0}]
+    view = build_metric_view(m.snapshot(), tail)
+    assert view["counter:updates_received"] == 4.0
+    assert view["gauge:alerts_firing"] == 1.0
+    assert view["timer:round_s:p95"] > 0
+    assert view["rounds.straggler_rate"] == 0.25
+    assert view["rounds.tail"] == 1.0
+
+
+def test_derive_rounds_tail_ratios_need_both_halves():
+    fast = [{"outcome": "completed", "duration_s": 0.1,
+             "participants": 2, "stragglers": []}] * 2
+    m = derive_rounds_tail(fast + fast)
+    assert m["rounds.duration_p95_ratio"] == pytest.approx(1.0)
+    assert "rounds.duration_p95_ratio" not in derive_rounds_tail(fast[:3])
+    slow = [{"outcome": "completed", "duration_s": 0.4,
+             "participants": 2, "stragglers": []}] * 2
+    m = derive_rounds_tail(fast + slow)
+    assert m["rounds.duration_p95_ratio"] == pytest.approx(4.0)
+
+
+def test_derive_rounds_tail_recompile_and_mfu():
+    rounds = [
+        {"outcome": "completed", "duration_s": 0.1, "participants": 1,
+         "stragglers": [], "compute": {"mfu": mfu, "recompile_storms": rs}}
+        for mfu, rs in ((0.6, []), (0.6, []), (0.2, ["w0"]), (0.2, []))
+    ]
+    m = derive_rounds_tail(rounds)
+    assert m["rounds.recompile_storm_rounds"] == 1.0
+    assert m["rounds.mfu_mean"] == pytest.approx(0.4)
+    assert m["rounds.mfu_ratio"] == pytest.approx(0.2 / 0.6)
+
+
+def test_windowed_rate_needs_two_samples_in_window():
+    hist = [{"ts": 0.0, "counters": {"c": 0}},
+            {"ts": 50.0, "counters": {"c": 100}}]
+    rate, why = windowed_rate(hist, "c", window_s=10.0, now=100.0)
+    assert rate is None and "need >= 2" in why
+    rate, why = windowed_rate(hist, "c", window_s=200.0, now=100.0)
+    assert why is None and rate == pytest.approx(2.0)
+
+
+# ----------------------------------------------------------------------
+# lifecycle (fake clock)
+
+
+def _engine(rule_overrides=None, **engine_kwargs):
+    clock = {"t": 0.0}
+    rule = {"name": "r", "metric": "gauge:load", "op": ">",
+            "threshold": 1.0, "for_s": 0.0, "cooldown_s": 60.0}
+    rule.update(rule_overrides or {})
+    metrics = Metrics()
+    engine = AlertEngine([rule], metrics=metrics,
+                         now=lambda: clock["t"], **engine_kwargs)
+    return engine, clock, metrics
+
+
+def _tick(engine, clock, value, at=None):
+    if at is not None:
+        clock["t"] = at
+    return engine.evaluate({"gauge:load": value})
+
+
+def test_immediate_fire_and_resolve_once():
+    engine, clock, metrics = _engine()
+    events = _tick(engine, clock, 5.0, at=0.0)
+    assert [e["event"] for e in events] == ["pending", "firing"]
+    assert engine.firing() == ["r"]
+    # still breaching: no duplicate events
+    assert _tick(engine, clock, 5.0, at=1.0) == []
+    events = _tick(engine, clock, 0.0, at=2.0)
+    assert [e["event"] for e in events] == ["resolved"]
+    # already ok: resolving again emits nothing
+    assert _tick(engine, clock, 0.0, at=3.0) == []
+    c = metrics.snapshot()["counters"]
+    assert c["alerts_fired_total"] == 1
+    assert c["alerts_resolved_total"] == 1
+
+
+def test_for_s_hold_suppresses_transient_spike():
+    engine, clock, metrics = _engine({"for_s": 5.0})
+    events = _tick(engine, clock, 5.0, at=0.0)
+    assert [e["event"] for e in events] == ["pending"]
+    # spike gone before the hold elapsed: silently back to ok — no
+    # firing episode, no resolved event
+    assert _tick(engine, clock, 0.5, at=2.0) == []
+    assert engine.firing() == []
+    assert _tick(engine, clock, 5.0, at=3.0) != []   # pending again
+    assert [e["event"] for e in _tick(engine, clock, 5.0, at=9.0)] == [
+        "firing"
+    ]
+    assert metrics.snapshot()["counters"]["alerts_fired_total"] == 1
+
+
+def test_cooldown_suppresses_refire():
+    engine, clock, _ = _engine()
+    _tick(engine, clock, 5.0, at=0.0)            # fire
+    _tick(engine, clock, 0.0, at=10.0)           # resolve, cooldown to 70
+    assert _tick(engine, clock, 5.0, at=30.0) == []
+    assert engine.firing() == []
+    events = _tick(engine, clock, 5.0, at=71.0)
+    assert [e["event"] for e in events] == ["pending", "firing"]
+    snap = engine.status_snapshot()
+    assert snap["rules"][0]["episodes"] == 2
+
+
+def test_hysteresis_flap_is_one_episode():
+    engine, clock, _ = _engine()
+    _tick(engine, clock, 5.0, at=0.0)
+    # dips below the trigger (1.0) but above the clear line (0.9):
+    # still firing, no resolve — a flap is ONE episode
+    assert _tick(engine, clock, 0.95, at=1.0) == []
+    assert engine.firing() == ["r"]
+    assert _tick(engine, clock, 5.0, at=2.0) == []
+    events = _tick(engine, clock, 0.5, at=3.0)
+    assert [e["event"] for e in events] == ["resolved"]
+    snap = engine.status_snapshot()
+    assert snap["rules"][0]["episodes"] == 1
+    assert snap["rules"][0]["recent_transitions"].count("resolved") == 1
+
+
+def test_burn_rate_needs_both_windows():
+    clock = {"t": 100.0}
+    engine = AlertEngine(
+        [{"name": "burn", "metric": "counter:errs",
+          "burn_rate": {"short_s": 10.0, "long_s": 100.0,
+                        "threshold": 1.0}}],
+        now=lambda: clock["t"],
+    )
+    # short window hot (10/s), long window cool (0.5/s): must NOT fire
+    hist = [{"ts": 0.0, "counters": {"errs": 0}},
+            {"ts": 50.0, "counters": {"errs": 0}},
+            {"ts": 95.0, "counters": {"errs": 0}},
+            {"ts": 100.0, "counters": {"errs": 50}}]
+    assert engine.evaluate({}, history=hist) == []
+    assert engine.firing() == []
+    # both windows hot: fires
+    hist = [{"ts": 0.0, "counters": {"errs": 0}},
+            {"ts": 50.0, "counters": {"errs": 100}},
+            {"ts": 95.0, "counters": {"errs": 150}},
+            {"ts": 100.0, "counters": {"errs": 200}}]
+    events = engine.evaluate({}, history=hist)
+    assert [e["event"] for e in events] == ["pending", "firing"]
+    # no history at all: not evaluable — holds state, records the why
+    clock["t"] = 101.0
+    assert engine.evaluate({}, history=None) == []
+    assert engine.firing() == ["burn"]
+    snap = engine.status_snapshot()
+    assert "holds 0 samples" in snap["rules"][0]["skip_reason"]
+
+
+def test_evaluation_failure_is_isolated():
+    engine, clock, metrics = _engine()
+
+    class BadView(dict):
+        def get(self, key, default=None):
+            raise RuntimeError("boom")
+
+    engine.evaluate(BadView())          # must not raise
+    assert metrics.snapshot()["counters"]["alerts_eval_errors"] == 1
+    snap = engine.status_snapshot()
+    assert "boom" in snap["rules"][0]["skip_reason"]
+    # and the rule still works on the next good tick
+    assert [e["event"] for e in _tick(engine, clock, 5.0, at=1.0)] == [
+        "pending", "firing"
+    ]
+
+
+def test_broken_capture_hook_is_isolated():
+    def bad_hook(rule, event):
+        raise RuntimeError("capture exploded")
+
+    engine, clock, metrics = _engine({"capture": True},
+                                     on_capture=bad_hook)
+    events = _tick(engine, clock, 5.0, at=0.0)
+    assert engine.firing() == ["r"]
+    assert events[-1]["capture_armed"] is True
+    c = metrics.snapshot()["counters"]
+    assert c["alerts_captures_armed"] == 1
+    assert c["alerts_eval_errors"] == 1
+
+
+def test_capture_hook_receives_rule_and_event():
+    captured = []
+    engine, clock, _ = _engine(
+        {"capture": True},
+        on_capture=lambda rule, event: captured.append((rule, event)),
+    )
+    _tick(engine, clock, 5.0, at=0.0)
+    assert len(captured) == 1
+    rule, event = captured[0]
+    assert rule.name == "r" and event["event"] == "firing"
+
+
+def test_alerts_jsonl_lifecycle_and_torn_line(tmp_path):
+    path = str(tmp_path / "alerts.jsonl")
+    engine, clock, _ = _engine(log_path=path)
+    _tick(engine, clock, 5.0, at=0.0)
+    _tick(engine, clock, 0.0, at=1.0)
+    engine.log_event({"ts": 2.0, "event": "forensics", "digest": "abc"})
+    events, n_torn = read_alerts_jsonl(path)
+    assert n_torn == 0
+    assert [e["event"] for e in events] == [
+        "pending", "firing", "resolved", "forensics"
+    ]
+    assert all(e["node"] == "manager" for e in events)
+    assert events[1]["rule"] == "r" and events[1]["threshold"] == 1.0
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"torn": ')
+    events, n_torn = read_alerts_jsonl(path)
+    assert len(events) == 4 and n_torn == 1
+
+
+# ----------------------------------------------------------------------
+# forensics manifests + store
+
+
+def test_build_manifest_null_with_reason():
+    manifest = forensics.build_manifest(
+        rule="straggler_rate", severity="page", round_name="r3",
+        trace_id="t" * 32, armed_ts=1.0, captured_ts=2.0,
+        sections={"task_stacks": [{"name": "t0"}],
+                  "fleet_slice": {"clients": {}}},
+        reasons={"jax_profile": "armed but no step ran"},
+    )
+    assert forensics.validate_manifest(manifest) == []
+    assert manifest["sections_present"] == 2
+    body = manifest["sections"]
+    assert len(forensics.EVIDENCE_SECTIONS) >= 5
+    for name in forensics.EVIDENCE_SECTIONS:
+        assert name in body
+        if body[name] is None:
+            assert body[f"{name}_reason"]
+    assert body["jax_profile_reason"] == "armed but no step ran"
+    # stock reason fills sections the caller said nothing about
+    assert body["round_trace_reason"]
+
+
+def test_manifest_missing_section_is_a_violation():
+    manifest = forensics.build_manifest(rule="r")
+    del manifest["sections"]["loop_lag"]
+    bad = forensics.validate_manifest(manifest)
+    assert any("loop_lag" in v for v in bad)
+    store = forensics.ForensicsStore()
+    with pytest.raises(ValueError, match="refusing to store"):
+        store.put(manifest)
+
+
+def test_store_content_addressing_and_persistence(tmp_path):
+    store = forensics.ForensicsStore(str(tmp_path / "bundles"))
+    m1 = forensics.build_manifest(rule="a", captured_ts=1.0)
+    m2 = forensics.build_manifest(rule="a", captured_ts=1.0)
+    m3 = forensics.build_manifest(rule="b", captured_ts=1.0)
+    d1, d2, d3 = store.put(m1), store.put(m2), store.put(m3)
+    assert d1 == d2 != d3          # same content, same address
+    assert len(d1) == 32
+    assert store.get(d1)["rule"] == "a"
+    assert store.get("0" * 32) is None
+    # persisted file survives a fresh store (process restart)
+    reborn = forensics.ForensicsStore(str(tmp_path / "bundles"))
+    assert reborn.get(d3)["rule"] == "b"
+    index = store.list_bundles()
+    assert [b["digest"] for b in index] == [d3, d1]   # newest first
+    assert all("sections" not in b for b in index)
+
+
+def test_store_eviction_bounds_memory_and_disk(tmp_path):
+    store = forensics.ForensicsStore(str(tmp_path / "b"), max_bundles=2)
+    digests = [
+        store.put(forensics.build_manifest(rule=f"r{i}", captured_ts=float(i)))
+        for i in range(4)
+    ]
+    assert len(store) == 2
+    assert store.get(digests[0]) is None
+    assert store.get(digests[-1]) is not None
+    on_disk = sorted(p.name for p in (tmp_path / "b").iterdir())
+    assert on_disk == sorted(f"{d}.json" for d in digests[-2:])
+
+
+def test_referenced_trace_ids_exempt_spool_gc(tmp_path):
+    store = forensics.ForensicsStore(max_bundles=4)
+    tid = "a" * 32
+    store.put(forensics.build_manifest(rule="r", trace_id=tid,
+                                       captured_ts=1.0))
+    assert store.referenced_trace_ids() == {tid}
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    old = time.time() - 7200
+    for name in (tid, "b" * 32, "c" * 32):
+        p = spool / f"{name}.jsonl"
+        p.write_text("{}\n")
+        os.utime(p, (old, old))
+    removed = gc_spool(str(spool), max_age_s=3600.0,
+                       exempt=store.referenced_trace_ids())
+    assert removed == 2
+    assert sorted(p.name for p in spool.iterdir()) == [f"{tid}.jsonl"]
+
+
+def test_gc_spool_count_bound_removes_oldest(tmp_path):
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    now = time.time()
+    for i in range(5):
+        p = spool / f"{i:032d}.jsonl"
+        p.write_text("{}\n")
+        os.utime(p, (now - 100 + i, now - 100 + i))
+    removed = gc_spool(str(spool), max_age_s=1e9, max_files=2)
+    assert removed == 3
+    assert sorted(p.name for p in spool.iterdir()) == [
+        f"{3:032d}.jsonl", f"{4:032d}.jsonl"
+    ]
+
+
+def test_maybe_rotate_jsonl(tmp_path):
+    path = str(tmp_path / "rounds.jsonl")
+    with open(path, "w") as fh:
+        fh.write("x" * 100)
+    assert maybe_rotate_jsonl(path, max_bytes=1000) is False
+    assert maybe_rotate_jsonl(path, max_bytes=50) is True
+    assert not os.path.exists(path)
+    assert os.path.getsize(path + ".1") == 100
+    assert maybe_rotate_jsonl(str(tmp_path / "absent.jsonl"),
+                              max_bytes=1) is False
+
+
+def test_profile_dir_summary(tmp_path):
+    assert forensics.profile_dir_summary(None) is None
+    assert forensics.profile_dir_summary(str(tmp_path / "nope")) is None
+    d = tmp_path / "prof"
+    (d / "plugins").mkdir(parents=True)
+    (d / "plugins" / "trace.pb").write_bytes(b"abc")
+    out = forensics.profile_dir_summary(str(d))
+    assert out["total_bytes"] == 3
+    assert out["files"][0]["path"] == os.path.join("plugins", "trace.pb")
+
+
+def test_dump_asyncio_tasks_requires_loop():
+    with pytest.raises(RuntimeError):
+        forensics.dump_asyncio_tasks()
+
+    async def main():
+        return forensics.dump_asyncio_tasks()
+
+    tasks = asyncio.run(main())
+    assert tasks and tasks[0]["current"] is True
+    assert tasks[0]["stack"]
+
+
+# ----------------------------------------------------------------------
+# loadgen: scenario block + alert:* namespace
+
+
+def _scn(alerts=None):
+    d = {"name": "s", "phases": [{"duration_s": 1}]}
+    if alerts is not None:
+        d["alerts"] = alerts
+    return parse_scenario(d)
+
+
+def test_scenario_alerts_defaults_and_custom_rules():
+    scn = _scn()
+    assert scn.alerts.enabled and scn.alerts.rules is None
+    scn = _scn({"enabled": False})
+    assert not scn.alerts.enabled
+    scn = _scn({"interval_s": 0.1, "rounds_window": 2, "rules": [
+        {"name": "r", "metric": "counter:updates_received",
+         "threshold": 5}]})
+    assert scn.alerts.rules[0]["name"] == "r"
+
+
+def test_scenario_alerts_typo_fails_at_load():
+    with pytest.raises(ScenarioError, match="treshold"):
+        _scn({"rules": [{"name": "r", "metric": "counter:x",
+                         "treshold": 5}]})
+    with pytest.raises(ScenarioError, match="unknown key"):
+        _scn({"interval": 1.0})
+
+
+def test_derive_alert_metrics_counts_transitions():
+    events = [
+        {"event": "pending", "rule": "a", "severity": "page"},
+        {"event": "firing", "rule": "a", "severity": "page"},
+        {"event": "resolved", "rule": "a", "severity": "page"},
+        {"event": "firing", "rule": "a", "severity": "page"},
+        {"event": "firing", "rule": "b", "severity": "warn"},
+        {"event": "forensics", "rule": "a", "digest": "d"},
+    ]
+    m = derive_alert_metrics(events)
+    assert m["alert:fired:a"] == 2.0
+    assert m["alert:fired:b"] == 1.0
+    assert m["alert:fired_total"] == 3.0
+    assert m["alert:pages_fired"] == 2.0
+    assert m["alert:resolved:a"] == 1.0
+    assert m["alert:forensics_bundles"] == 1.0
+    # absence-is-zero: a quiet run's alert: addresses resolve to 0
+    assert resolve_metric(m, "alert:fired:never") == 0.0
+    assert resolve_metric(derive_alert_metrics([]),
+                          "alert:fired_total") == 0.0
+
+
+# ----------------------------------------------------------------------
+# ops console: alert pane + page extraction
+
+
+def _console_state(root_rules, edge_rules=()):
+    def node(url, label, rules):
+        return {"url": url, "up": True, "metrics": {}, "health": None,
+                "alerts": {"node": label, "rules": list(rules)}}
+
+    return {"root": node("http://r/x", "manager", root_rules),
+            "edges": [node("http://e/x", "edge:e0", edge_rules)]}
+
+
+def test_firing_alerts_extracts_across_tiers_and_filters_severity():
+    state = _console_state(
+        [{"name": "a", "state": "firing", "severity": "page"},
+         {"name": "b", "state": "pending", "severity": "page"}],
+        [{"name": "c", "state": "firing", "severity": "warn"}],
+    )
+    firing = console.firing_alerts(state)
+    assert {(f["node"], f["name"]) for f in firing} == {
+        ("manager", "a"), ("edge:e0", "c")
+    }
+    pages = console.firing_alerts(state, severity="page")
+    assert [f["name"] for f in pages] == ["a"]
+    # pre-alerts node (alerts=None) is renderable, not a crash
+    state["root"]["alerts"] = None
+    assert console.firing_alerts(state, severity="page") == []
+
+
+def test_alert_pane_quiet_fleet_is_silent():
+    paint = lambda style, text: text  # noqa: E731
+    state = _console_state(
+        [{"name": "a", "state": "ok", "severity": "warn"}]
+    )
+    assert console._alert_pane(state, paint) == []
+    state = _console_state(
+        [{"name": "a", "state": "firing", "severity": "page",
+          "metric": "rounds.straggler_rate", "op": ">",
+          "threshold": 0.25, "value": 0.5, "episodes": 1}]
+    )
+    lines = console._alert_pane(state, paint)
+    assert lines[0] == "  alerts:"
+    assert "FIRING" in lines[1] and "[page]" in lines[1]
+    assert "straggler_rate" in lines[1]
+
+
+# ----------------------------------------------------------------------
+# e2e harness
+
+
+async def _start_app(app, port):
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", port).start()
+    return runner
+
+
+async def _wait_for(predicate, timeout_s=20.0, interval=0.05):
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _build_fleet(tmp_path, name, n_workers=3, with_edge=True,
+                       interval_s=0.1, rounds_window=2,
+                       round_timeout=30.0, alert_rules=None):
+    model = linear_regression_model(10)
+    trainer = make_local_trainer(model, batch_size=16, learning_rate=0.02)
+    nprng = np.random.default_rng(7)
+
+    mport = _free_port()
+    minj = FaultInjector()
+    mapp = web.Application(middlewares=[minj.middleware])
+    exp = Manager(mapp).register_experiment(
+        model, name=name, round_timeout=round_timeout, client_ttl=300.0,
+        rounds_log_path=str(tmp_path / "rounds.jsonl"),
+        alert_rules=alert_rules,
+        alerts_log_path=str(tmp_path / "alerts.jsonl"),
+        alerts_interval_s=interval_s,
+        alerts_rounds_window=rounds_window,
+        forensics_dir=str(tmp_path / "forensics"),
+        metrics_history_interval_s=0.2,
+    )
+    runners = [await _start_app(mapp, mport)]
+
+    edge = None
+    eport = None
+    einj = FaultInjector()
+    if with_edge:
+        eport = _free_port()
+        eapp = web.Application(middlewares=[einj.middleware])
+        edge = EdgeAggregator(
+            eapp, f"127.0.0.1:{mport}", name=name, port=eport,
+            edge_name="e0", ship_settle_s=0.05, heartbeat_time=5.0,
+            alerts_interval_s=interval_s,
+        )
+        runners.append(await _start_app(eapp, eport))
+
+    workers = []
+    for i in range(n_workers):
+        data = linear_client_data(nprng, min_batches=2, max_batches=2,
+                                  batch_size=16)
+        wapp = web.Application()
+        w = ExperimentWorker(
+            wapp, model, f"127.0.0.1:{mport}", name=name,
+            port=_free_port(), heartbeat_time=5.0, trainer=trainer,
+            get_data=lambda d=data: (d, d["x"].shape[0]),
+            outbox_backoff=(0.05, 0.4),
+            edge=f"127.0.0.1:{eport}" if with_edge else None,
+        )
+        runners.append(await _start_app(wapp, w.port))
+        workers.append(w)
+    expected = n_workers + (1 if with_edge else 0)
+    assert await _wait_for(lambda: len(exp.registry) >= expected, 30.0), \
+        "fleet failed to register"
+    return exp, edge, workers, (minj, einj), runners, mport, eport
+
+
+async def _drive_round(mport, name, exp):
+    import aiohttp
+
+    before = exp.rounds.n_rounds
+    async with aiohttp.ClientSession() as s:
+        async with s.get(
+            f"http://127.0.0.1:{mport}/{name}/start_round?n_epoch=1"
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+    assert await _wait_for(
+        lambda: exp.rounds.n_rounds > before and not exp.rounds.in_progress,
+        60.0,
+    ), "round did not complete"
+
+
+# ----------------------------------------------------------------------
+# e2e: induced straggler phase → page fires → forensics bundle
+
+
+def test_e2e_straggler_fires_page_and_builds_bundle(tmp_path):
+    async def main():
+        import aiohttp
+
+        name = "ale2e"
+        interval_s = 0.1
+        # the default straggler rule, alone: the test's forensics and
+        # lifecycle asserts need exactly one capture-armed rule in play
+        rules = [dict(r) for r in DEFAULT_RULES
+                 if r["name"] == "straggler_rate"]
+        exp, edge, workers, (minj, einj), runners, mport, eport = (
+            await _build_fleet(tmp_path, name, rounds_window=1,
+                               round_timeout=3.0, alert_rules=rules)
+        )
+        gate = {"on": False}
+        # two of three workers ACK the broadcast (=> round participants)
+        # but their uploads are refused at BOTH tiers while gated: the
+        # watchdog ends the round with 2 recorded stragglers
+        for w in workers[1:]:
+            for inj in (minj, einj):
+                inj.error(f"update?client_id={w.client_id}", status=503,
+                          gate=lambda: gate["on"])
+        try:
+            for _ in range(2):
+                await _drive_round(mport, name, exp)
+            assert exp.alerts.firing() == []
+
+            gate["on"] = True
+            await _drive_round(mport, name, exp)
+            gate["on"] = False
+            t_done = time.time()
+            # >= 2 of 4 participants straggled (> 0.25): the page rule
+            # must fire within ~2 evaluation ticks of the round record
+            # landing (slack for thread scheduling)
+            assert await _wait_for(
+                lambda: "straggler_rate" in exp.alerts.firing(),
+                timeout_s=2 * interval_s + 1.0,
+            ), exp.alerts.status_snapshot()
+            events, _ = read_alerts_jsonl(str(tmp_path / "alerts.jsonl"))
+            fire = [e for e in events if e["event"] == "firing"
+                    and e["rule"] == "straggler_rate"]
+            assert len(fire) == 1 and fire[0]["capture_armed"] is True
+            assert fire[0]["ts"] - t_done < 2 * interval_s + 1.0
+            assert fire[0]["severity"] == "page"
+
+            # the armed capture materializes when the NEXT round ends
+            await _drive_round(mport, name, exp)
+            assert await _wait_for(lambda: len(exp.forensics) >= 1, 10.0)
+
+            async with aiohttp.ClientSession() as s:
+                base = f"http://127.0.0.1:{mport}/{name}"
+                async with s.get(f"{base}/alerts") as resp:
+                    assert resp.status == 200
+                    snap = await resp.json()
+                async with s.get(f"{base}/forensics") as resp:
+                    assert resp.status == 200
+                    index = (await resp.json())["bundles"]
+                assert index and index[0]["rule"] == "straggler_rate"
+                async with s.get(
+                    f"{base}/forensics/{index[0]['digest']}"
+                ) as resp:
+                    assert resp.status == 200
+                    manifest = await resp.json()
+                async with s.get(f"{base}/forensics/{'0' * 32}") as resp:
+                    assert resp.status == 404
+                # every edge serves its own /alerts too
+                async with s.get(
+                    f"http://127.0.0.1:{eport}/{name}/alerts"
+                ) as resp:
+                    assert resp.status == 200
+                    esnap = await resp.json()
+
+            assert snap["node"] == "manager"
+            assert {r["name"] for r in snap["rules"]} == {"straggler_rate"}
+            assert esnap["node"] == "edge:e0"
+            assert esnap["summary"]["firing"] == 0
+
+            # the bundle contract: >= 5 evidence sections, every absent
+            # one excused — the null-with-reason invariant end to end
+            assert len(forensics.EVIDENCE_SECTIONS) >= 5
+            assert forensics.validate_manifest(manifest) == []
+            body = manifest["sections"]
+            for section in forensics.EVIDENCE_SECTIONS:
+                assert section in body
+                if body[section] is None:
+                    assert body[f"{section}_reason"], section
+            assert manifest["rule"] == "straggler_rate"
+            assert manifest["severity"] == "page"
+            assert body["task_stacks"], "live loop must dump task stacks"
+            assert body["fleet_slice"] is not None
+            assert body["round_trace"]["traceEvents"]
+            assert body["metric_history"]
+            # the bundle pins its round's trace against spool GC
+            assert exp.forensics.referenced_trace_ids()
+            # persisted bundle rides CI artifact uploads
+            disk = os.listdir(str(tmp_path / "forensics"))
+            assert f"{manifest['digest']}.json" in disk
+
+            # a clean tail slides the window past the straggler round:
+            # the alert resolves exactly once
+            await _drive_round(mport, name, exp)
+            assert await _wait_for(
+                lambda: exp.alerts.firing() == [], 10.0
+            ), exp.alerts.status_snapshot()
+            events, _ = read_alerts_jsonl(str(tmp_path / "alerts.jsonl"))
+            seq = [e["event"] for e in events
+                   if e.get("rule") == "straggler_rate"
+                   and e["event"] != "forensics"]
+            assert seq == ["pending", "firing", "resolved"]
+            forensic_events = [e for e in events
+                               if e["event"] == "forensics"]
+            assert len(forensic_events) == 1
+            assert forensic_events[0]["digest"] == manifest["digest"]
+        finally:
+            for r in reversed(runners):
+                await r.cleanup()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------------
+# e2e: quiet fleet fires nothing
+
+
+def test_e2e_quiet_fleet_fires_zero_alerts(tmp_path):
+    async def main():
+        name = "alq"
+        exp, _, workers, _, runners, mport, _ = await _build_fleet(
+            tmp_path, name, with_edge=False
+        )
+        try:
+            for _ in range(5):
+                await _drive_round(mport, name, exp)
+            await asyncio.sleep(0.3)   # a few more evaluation ticks
+            assert exp.alerts.firing() == []
+            snap = exp.alerts.status_snapshot()
+            assert snap["summary"]["firing"] == 0
+            assert snap["summary"]["page_firing"] == 0
+            counters = exp.metrics_snapshot()["counters"]
+            assert counters.get("alerts_fired_total", 0) == 0
+            assert len(exp.forensics) == 0
+            if os.path.exists(str(tmp_path / "alerts.jsonl")):
+                events, _ = read_alerts_jsonl(
+                    str(tmp_path / "alerts.jsonl")
+                )
+                assert [e for e in events if e["event"] == "firing"] == []
+        finally:
+            for r in reversed(runners):
+                await r.cleanup()
+
+    asyncio.run(main())

@@ -1061,6 +1061,108 @@ def test_btl032_scoped_and_suppressible():
 
 
 # ----------------------------------------------------------------------
+# BTL033 — alert rule metric selectors (the consumer half of BTL030:
+# a typo'd selector parses fine and the alert silently never fires)
+
+
+def test_btl033_flags_selector_typos_in_every_namespace():
+    findings = lint(
+        """
+        RULES = [
+            {"name": "c", "metric": "counter:updates_recieved",
+             "threshold": 1},
+            {"name": "t", "metric": "timer:round_z:p95", "threshold": 1},
+            {"name": "s", "metric": "timer:round_s:p96", "threshold": 1},
+            {"name": "g", "metric": "gauge:outbox_pendign",
+             "threshold": 1},
+            {"name": "r", "metric": "rounds.straggler_ratio",
+             "threshold": 1},
+            {"name": "n", "metric": "lag_p95", "threshold": 1},
+        ]
+        """,
+        rules=["BTL033"],
+        registry=DICT_REGISTRY,
+    )
+    assert rules_of(findings) == ["BTL033"] * 6
+    assert "updates_recieved" in findings[0].message
+    assert "DECLARED_TIMERS" in findings[1].message
+    assert "p96" in findings[2].message
+    assert "DECLARED_GAUGES" in findings[3].message
+    assert "rounds.straggler_ratio" in findings[4].message
+    assert "evaluable namespace" in findings[5].message
+
+
+def test_btl033_declared_selectors_pass():
+    findings = lint(
+        """
+        RULES = [
+            {"name": "a", "metric": "counter:updates_received",
+             "threshold": 1},
+            {"name": "b", "metric": "counter:updates_abandoned_410",
+             "burn_rate": {"short_s": 60, "long_s": 3600,
+                           "threshold": 0.1}},
+            {"name": "c", "metric": "timer:round_s:p95", "threshold": 1},
+            {"name": "d", "metric": "gauge:outbox_pending",
+             "threshold": 10, "severity": "page"},
+            {"name": "e", "metric": "rounds.straggler_rate",
+             "threshold": 0.25, "capture": True},
+        ]
+        """,
+        rules=["BTL033"],
+        registry=DICT_REGISTRY,
+    )
+    assert findings == []
+
+
+def test_btl033_only_audits_rule_shaped_dicts():
+    findings = lint(
+        """
+        # SLO assertion: has `metric` but no `name` — out of scope
+        A = {"metric": "counter:nope_at_all", "op": ">", "value": 1}
+        # name+metric but no rule marker key — not a rule shape either
+        B = {"name": "row", "metric": "counter:nope_at_all"}
+        # dynamic selector: nothing checkable
+        def f(sel):
+            return {"name": "dyn", "metric": sel, "threshold": 1}
+        """,
+        rules=["BTL033"],
+        registry=DICT_REGISTRY,
+    )
+    assert findings == []
+
+
+def test_btl033_legacy_registry_skips_timer_gauge_names():
+    src = """
+    RULES = [
+        {"name": "t", "metric": "timer:round_z:p95", "threshold": 1},
+        {"name": "g", "metric": "gauge:outbox_pendign", "threshold": 1},
+        {"name": "s", "metric": "timer:round_s:p96", "threshold": 1},
+        {"name": "c", "metric": "counter:updates_recieved",
+         "threshold": 1},
+    ]
+    """
+    # the 2-tuple registry carries no timer/gauge sets: those NAME
+    # audits degrade away, but stat suffixes and counters still check
+    findings = lint(src, rules=["BTL033"], registry=REGISTRY)
+    assert len(findings) == 2
+    assert "p96" in findings[0].message
+    assert "updates_recieved" in findings[1].message
+    assert lint(src, rules=["BTL033"], registry=None) == []
+
+
+def test_btl033_audits_beyond_server_paths():
+    # rule packs live in obs/ (default pack), tests, operator configs —
+    # the audit follows the registry, not the server/ path scope
+    src = """
+    RULES = [{"name": "x", "metric": "counter:nope_at_all",
+              "threshold": 1}]
+    """
+    for path in ("baton_tpu/obs/fixture.py", "baton_tpu/core/fixture.py"):
+        assert rules_of(lint(src, path=path, rules=["BTL033"],
+                             registry=DICT_REGISTRY)) == ["BTL033"]
+
+
+# ----------------------------------------------------------------------
 # compute-plane metric names — the probe's emission sites live under
 # server/, so a typo'd compute name would silently zero a gated
 # compute:* SLO metric; these fixtures pin the names BTL030/BTL032 must
@@ -1134,7 +1236,7 @@ def test_all_rules_table():
     table = all_rules()
     assert set(table) == {
         "BTL001", "BTL002", "BTL003", "BTL010", "BTL011", "BTL020",
-        "BTL030", "BTL031", "BTL032",
+        "BTL030", "BTL031", "BTL032", "BTL033",
     }
     assert all(table.values())
 
