@@ -53,6 +53,7 @@ import json
 import logging
 import os
 import random
+import shutil
 import socket
 import time
 from typing import List, Optional
@@ -138,6 +139,23 @@ class _EdgeSlot:
         self.alive = True
 
 
+class _RootSlot:
+    """One root replica (``manager.standby_roots``): its Experiment, its
+    server runner, its loopback port. The active's kill is the same cold
+    teardown as an edge death; a standby becomes the new active via the
+    lease-expiry promotion in server/replication."""
+
+    __slots__ = ("rid", "exp", "runner", "port", "alive")
+
+    def __init__(self, rid: str, exp, runner: web.AppRunner,
+                 port: int) -> None:
+        self.rid = rid
+        self.exp = exp
+        self.runner = runner
+        self.port = port
+        self.alive = True
+
+
 class ScenarioRunner:
     """Drives one scenario end to end; :meth:`run` returns the summary
     dict (also written to ``scenario_summary.json``)."""
@@ -156,6 +174,7 @@ class ScenarioRunner:
         # as edge_metrics.json, addressed as ``edge:*``)
         self.edge_metrics = Metrics()
         self._edge_slots: List[_EdgeSlot] = []
+        self._root_slots: List[_RootSlot] = []
         self._topology: Optional[EdgeTopology] = None
         self.rounds_path = os.path.join(artifacts_dir, "rounds.jsonl")
         self.alerts_path = os.path.join(artifacts_dir, "alerts.jsonl")
@@ -221,6 +240,158 @@ class ScenarioRunner:
                 return f"127.0.0.1:{slot.port}"
         return None
 
+    # -- root replicas -------------------------------------------------
+    async def _spawn_standby(self, i: int, port: int,
+                             standby_ports: List[int]) -> _RootSlot:
+        """One warm standby root: a real manager on its own socket whose
+        journal file is written by the WalReceiver. It shares the
+        active's rounds/alerts log paths — after promotion its records
+        continue the same streams the SLO evaluator reads. Its alert
+        rules are empty (a standby evaluating fleet rules against an
+        empty registry would fire spurious pages); ``log_event`` aborts
+        still land in alerts.jsonl."""
+        scn = self.scenario
+        wal_dir = os.path.join(self.artifacts_dir, "wal")
+        rid = f"root-{i}"
+        sapp = web.Application()
+        exp = Manager(sapp).register_experiment(
+            self._model, name=scn.name,
+            round_timeout=scn.manager.round_timeout,
+            client_ttl=scn.manager.client_ttl,
+            cohort_fraction=scn.manager.cohort_fraction,
+            min_cohort=scn.manager.min_cohort,
+            ingest_workers=scn.manager.ingest_workers,
+            streaming_aggregation=scn.manager.streaming_aggregation,
+            rounds_log_path=self.rounds_path,
+            alert_rules=(), alerts_interval_s=0.0,
+            alerts_log_path=(self.alerts_path if scn.alerts.enabled
+                             else None),
+            journal_path=os.path.join(wal_dir, f"{rid}.jsonl"),
+            journal_fsync="never",
+            recovery_policy="resume",
+            ha_role="standby",
+            ha_replica_id=rid,
+            ha_standbys=[f"http://127.0.0.1:{p}" for p in standby_ports
+                         if p != port],
+            ha_lease_s=scn.manager.ha_lease_s,
+            ha_ship_interval_s=scn.manager.ha_ship_interval_s,
+            ha_promote_grace_s=scn.manager.ha_promote_grace_s,
+            ha_token=f"loadgen-{scn.name}",
+            chunk_spill_dir=os.path.join(wal_dir, f"spill-{rid}"),
+        )
+        runner = web.AppRunner(sapp)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        self._runners.append(runner)
+        slot = _RootSlot(rid, exp, runner, port)
+        self._root_slots.append(slot)
+        return slot
+
+    async def _kill_root(self) -> None:
+        """Cold teardown of the active root replica, then block until a
+        warm standby observes lease expiry and promotes. The open-loop
+        clock, drain, and artifact scrapes all follow ``self._mport`` /
+        ``self._exp``, so retargeting them here moves the whole driver
+        to the new active."""
+        scn = self.scenario
+        active = next(
+            (s for s in self._root_slots
+             if s.alive and s.port == self._mport),
+            None,
+        )
+        if active is None:
+            log.warning("loadgen: kill_root with no live active root")
+            return
+        # strike at the most adversarial moment: a round mid-flight with
+        # some updates accepted and already WAL-shipped, others still
+        # outstanding. That is the moment the chaos target is about —
+        # the promoted standby must resume the round and reuse the
+        # journaled payloads (zero retraining for delivered clients).
+        # The driver orchestrates the victim round itself instead of
+        # hoping phase-boundary timing lands inside one: wait for the
+        # fleet to go idle, fire a fresh round (the phase's faults —
+        # e.g. a manager-side update delay — hold part of the fleet
+        # outstanding), wait for the accepted set to stop growing and
+        # for the shipper to put it on the standbys, then pull the plug.
+        standbys = [s for s in self._root_slots
+                    if s.alive and s.port != self._mport]
+
+        def _idle() -> bool:
+            if active.exp.rounds.in_progress:
+                return False
+            return all(
+                not s.worker.round_in_progress
+                and s.worker._pending is None
+                for s in self._slots if s.alive
+            )
+
+        if not await self._wait(_idle, timeout_s=20.0):
+            log.warning("loadgen: kill_root: fleet never went idle; "
+                        "striking anyway")
+        await self._fire_round()
+        rm = active.exp.rounds
+
+        def _partial() -> bool:
+            return (rm.in_progress and bool(rm.client_responses)
+                    and rm.clients_left > 0)
+
+        caught = await self._wait(_partial, timeout_s=15.0, dt=0.01)
+        if caught:
+            # let the accepted set settle (all undelayed updates in, the
+            # delayed ones still outstanding), then require the WAL
+            # through the last accepted payload applied on every standby
+            deadline = asyncio.get_running_loop().time() + 5.0
+            n_resp = -1
+            while asyncio.get_running_loop().time() < deadline:
+                n = len(rm.client_responses)
+                if n == n_resp or not _partial():
+                    break
+                n_resp = n
+                await asyncio.sleep(0.2)
+            try:
+                jsize = os.path.getsize(active.exp.journal.path)
+            except (OSError, AttributeError):
+                jsize = 0
+            await self._wait(
+                lambda: all(
+                    s.exp._wal_receiver is not None
+                    and (s.exp._wal_receiver.status().get("applied_offset")
+                         or 0) >= jsize
+                    for s in standbys
+                ),
+                timeout_s=5.0, dt=0.01,
+            )
+        else:
+            log.warning("loadgen: kill_root found no mid-round window "
+                        "within 15s; killing the active anyway")
+        active.alive = False
+        with contextlib.suppress(Exception):
+            await active.runner.cleanup()
+        self.metrics.inc("scenario_roots_killed")
+        log.info("loadgen: killed active root %s (port %d)",
+                 active.rid, active.port)
+        standbys = [s for s in self._root_slots if s.alive]
+        promoted: List[_RootSlot] = []
+
+        def _find():
+            promoted[:] = [s for s in standbys if s.exp.ha_role == "active"]
+            return bool(promoted)
+
+        timeout = max(
+            30.0,
+            20 * (scn.manager.ha_lease_s + scn.manager.ha_promote_grace_s),
+        )
+        if not await self._wait(_find, timeout_s=timeout):
+            raise RuntimeError(
+                f"no standby promoted within {timeout:.0f}s of killing "
+                f"{active.rid}"
+            )
+        new = promoted[0]
+        self._exp = new.exp
+        self._mport = new.port
+        log.info("loadgen: %s promoted (epoch %d), driver retargeted",
+                 new.rid, new.exp.ha_epoch)
+
     # -- fleet ---------------------------------------------------------
     async def _spawn_worker(self) -> _WorkerSlot:
         scn = self.scenario
@@ -235,9 +406,15 @@ class ScenarioRunner:
         )
         inj = FaultInjector()
         wapp = web.Application(middlewares=[inj.middleware])
+        # with root replicas the worker's failover ring holds every
+        # other root (joiners after a failover ring back to the dead
+        # active too — rotation skips it on transport error)
+        failover = [f"127.0.0.1:{s.port}" for s in self._root_slots
+                    if s.port != self._mport] or None
         worker = ExperimentWorker(
             wapp, self._model, f"127.0.0.1:{self._mport}",
             name=scn.name, port=_free_port(),
+            failover=failover,
             heartbeat_time=scn.workers.heartbeat_time,
             trainer=self._trainer,
             get_data=lambda d=data: (d, d["x"].shape[0]),
@@ -307,12 +484,20 @@ class ScenarioRunner:
         self._active_worker_faults = []
         for fs in phase.faults:
             if fs.target == "manager":
+                # NOTE: manager faults always target the run's ORIGINAL
+                # active root (its injector); faults in phases after a
+                # kill_root land on the dead replica and are inert
                 self._install_fault(fs, minj, record=True)
             else:
                 self._active_worker_faults.append(fs)
                 for slot in self._slots:
                     if slot.alive:
                         self._install_fault(fs, slot.injector, record=True)
+        if phase.kill_root:
+            # after fault installation: the victim round _kill_root
+            # fires must run under this phase's faults (that is how a
+            # scenario holds part of the fleet outstanding at the kill)
+            await self._kill_root()
         self.metrics.set_gauge("scenario_phase_index", idx)
         self.phase_log.append({
             "phase": phase.name, "index": idx,
@@ -392,6 +577,8 @@ class ScenarioRunner:
             learning_rate=scn.workers.learning_rate,
         )
         self._mport = _free_port()
+        standby_ports = [_free_port()
+                         for _ in range(scn.manager.standby_roots)]
         minj = FaultInjector()
         mapp = web.Application(middlewares=[minj.middleware])
         if scn.alerts.enabled:
@@ -408,6 +595,28 @@ class ScenarioRunner:
             )
         else:
             alerts_kwargs = dict(alert_rules=(), alerts_interval_s=0.0)
+        ha_kwargs = {}
+        if standby_ports:
+            # replicated control plane: the active journals every round
+            # (payloads included) and ships the WAL to the warm standbys;
+            # workers get the standby list as their failover ring
+            wal_dir = os.path.join(self.artifacts_dir, "wal")
+            # a fresh run must not recover a previous run's journal
+            shutil.rmtree(wal_dir, ignore_errors=True)
+            os.makedirs(wal_dir, exist_ok=True)
+            ha_kwargs = dict(
+                journal_path=os.path.join(wal_dir, "root-0.jsonl"),
+                journal_fsync="never",
+                recovery_policy="resume",
+                ha_role="active",
+                ha_replica_id="root-0",
+                ha_standbys=[f"http://127.0.0.1:{p}" for p in standby_ports],
+                ha_lease_s=scn.manager.ha_lease_s,
+                ha_ship_interval_s=scn.manager.ha_ship_interval_s,
+                ha_promote_grace_s=scn.manager.ha_promote_grace_s,
+                ha_token=f"loadgen-{scn.name}",
+                chunk_spill_dir=os.path.join(wal_dir, "spill-root-0"),
+            )
         self._exp = Manager(mapp).register_experiment(
             self._model, name=scn.name,
             round_timeout=scn.manager.round_timeout,
@@ -418,11 +627,17 @@ class ScenarioRunner:
             streaming_aggregation=scn.manager.streaming_aggregation,
             rounds_log_path=self.rounds_path,
             **alerts_kwargs,
+            **ha_kwargs,
         )
         mrunner = web.AppRunner(mapp)
         await mrunner.setup()
         await web.TCPSite(mrunner, "127.0.0.1", self._mport).start()
         self._runners.append(mrunner)
+        self._root_slots.append(
+            _RootSlot("root-0", self._exp, mrunner, self._mport)
+        )
+        for i, port in enumerate(standby_ports, start=1):
+            await self._spawn_standby(i, port, standby_ports)
         self._session = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=60)
         )
@@ -515,12 +730,14 @@ class ScenarioRunner:
         grace = (scn.rounds.drain_grace_s
                  if scn.rounds.drain_grace_s is not None
                  else scn.manager.round_timeout + 5.0)
+        # drain against the *current* active — a kill_root phase may
+        # have retargeted self._exp mid-run
         settled = await self._wait(
-            lambda: not exp.rounds.in_progress, timeout_s=grace
+            lambda: not self._exp.rounds.in_progress, timeout_s=grace
         )
         if not settled:
             self.metrics.inc("scenario_rounds_forced_end")
-            exp.end_round()
+            self._exp.end_round()
 
         # artifacts ---------------------------------------------------
         async with self._session.get(
@@ -574,6 +791,14 @@ class ScenarioRunner:
             "edges": {
                 "count": scn.edges.count,
                 "alive": sum(1 for s in self._edge_slots if s.alive),
+            },
+            "roots": {
+                "count": 1 + scn.manager.standby_roots,
+                "alive": sum(1 for s in self._root_slots if s.alive),
+                "active": next(
+                    (s.rid for s in self._root_slots
+                     if s.port == self._mport), None,
+                ),
             },
             "wall_started": round(wall0, 6),
             "rounds_fired": rounds_fired,

@@ -191,13 +191,19 @@ class PhaseSpec:
     #: edge indices (into ``edges.count``) torn down cold when this
     #: phase is entered — the edge-death chaos knob
     kill_edges: Tuple[int, ...] = ()
+    #: tear down the *active root replica* cold when this phase is
+    #: entered — the control-plane chaos knob. Requires
+    #: ``manager.standby_roots`` ≥ the number of kill_root phases: each
+    #: kill consumes one warm standby (the driver waits for lease-expiry
+    #: promotion and retargets the open-loop clock at the new active).
+    kill_root: bool = False
 
     @staticmethod
     def parse(d: Dict[str, Any], idx: int) -> "PhaseSpec":
         ctx = f"phases[{idx}]"
         f = _take(d, ctx, name=f"phase{idx}", duration_s=None,
                   availability=None, churn=None, faults=None,
-                  kill_edges=None)
+                  kill_edges=None, kill_root=False)
         if not isinstance(f["name"], str) or not f["name"]:
             raise ScenarioError(f"{ctx}: `name` must be a non-empty string")
         dur = _num(ctx, "duration_s", f["duration_s"], 1e-3)
@@ -219,7 +225,10 @@ class PhaseSpec:
             int(_num(f"{ctx}.kill_edges[{i}]", "index", k, 0))
             for i, k in enumerate(raw_kills)
         )
-        return PhaseSpec(f["name"], dur, avail, churn, faults, kills)
+        if not isinstance(f["kill_root"], bool):
+            raise ScenarioError(f"{ctx}: `kill_root` must be a boolean")
+        return PhaseSpec(f["name"], dur, avail, churn, faults, kills,
+                         f["kill_root"])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -328,13 +337,23 @@ class ManagerSpec:
     min_cohort: int = 1
     ingest_workers: int = 2
     streaming_aggregation: bool = True
+    #: warm standby root replicas behind the active (server/replication):
+    #: 0 (default) is the single-root topology. With standbys the active
+    #: journals every round and ships the WAL; workers get the standby
+    #: list as their ``failover`` ring.
+    standby_roots: int = 0
+    ha_lease_s: float = 1.0
+    ha_ship_interval_s: float = 0.25
+    ha_promote_grace_s: float = 0.5
 
     @staticmethod
     def parse(d: Dict[str, Any]) -> "ManagerSpec":
         ctx = "manager"
         f = _take(d, ctx, round_timeout=6.0, client_ttl=5.0,
                   cohort_fraction=1.0, min_cohort=1, ingest_workers=2,
-                  streaming_aggregation=True)
+                  streaming_aggregation=True, standby_roots=0,
+                  ha_lease_s=1.0, ha_ship_interval_s=0.25,
+                  ha_promote_grace_s=0.5)
         return ManagerSpec(
             round_timeout=_num(ctx, "round_timeout", f["round_timeout"], 0.1),
             client_ttl=_num(ctx, "client_ttl", f["client_ttl"], 0.1),
@@ -343,6 +362,13 @@ class ManagerSpec:
             min_cohort=int(_num(ctx, "min_cohort", f["min_cohort"], 1)),
             ingest_workers=int(_num(ctx, "ingest_workers", f["ingest_workers"], 0)),
             streaming_aggregation=bool(f["streaming_aggregation"]),
+            standby_roots=int(_num(ctx, "standby_roots", f["standby_roots"],
+                                   0)),
+            ha_lease_s=_num(ctx, "ha_lease_s", f["ha_lease_s"], 0.1),
+            ha_ship_interval_s=_num(ctx, "ha_ship_interval_s",
+                                    f["ha_ship_interval_s"], 0.01),
+            ha_promote_grace_s=_num(ctx, "ha_promote_grace_s",
+                                    f["ha_promote_grace_s"], 0.0),
         )
 
 
@@ -508,6 +534,7 @@ def parse_scenario(d: Dict[str, Any], base_dir: str = ".") -> Scenario:
     if not isinstance(phases_raw, list) or not phases_raw:
         raise ScenarioError("scenario needs a non-empty `phases` list")
     edges = EdgeSpec.parse(f["edges"] or {})
+    manager = ManagerSpec.parse(f["manager"] or {})
     phases = tuple(PhaseSpec.parse(p, i) for i, p in enumerate(phases_raw))
     for i, p in enumerate(phases):
         for k in p.kill_edges:
@@ -516,12 +543,24 @@ def parse_scenario(d: Dict[str, Any], base_dir: str = ".") -> Scenario:
                     f"phases[{i}]: kill_edges index {k} out of range "
                     f"(edges.count = {edges.count})"
                 )
+    n_root_kills = sum(1 for p in phases if p.kill_root)
+    if n_root_kills > manager.standby_roots:
+        raise ScenarioError(
+            f"{n_root_kills} kill_root phase(s) but manager.standby_roots = "
+            f"{manager.standby_roots} — each root kill consumes one warm "
+            f"standby"
+        )
+    if manager.standby_roots > 0 and edges.count > 0:
+        raise ScenarioError(
+            "manager.standby_roots with an edge tier is not supported yet "
+            "(edges have no root-failover ring)"
+        )
     return Scenario(
         name=name,
         seed=int(_num("scenario", "seed", f["seed"])),
         model_dim=int(_num("model", "dim", model["dim"], 1)),
         workers=WorkerSpec.parse(f["workers"] or {}),
-        manager=ManagerSpec.parse(f["manager"] or {}),
+        manager=manager,
         rounds=RoundsSpec.parse(f["rounds"] or {}),
         phases=phases,
         slo=SLOSpec.parse(f["slo"] or {}, base_dir),

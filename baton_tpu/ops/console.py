@@ -83,6 +83,8 @@ def poll_node(
         "health": health,
         # alerting plane (None against a pre-alerts node — renderable)
         "alerts": fetch_json(f"{base}/alerts", timeout_s),
+        # replication plane (None against a non-HA node — renderable)
+        "replication": fetch_json(f"{base}/replication", timeout_s),
     }
     if history_since is not None:
         out["history"] = fetch_json(
@@ -173,6 +175,36 @@ def _compute_line(node: dict, label: str) -> Optional[str]:
         f"reporters={_fmt_num(reporters, '{:.0f}')}  "
         f"recompile-storm={storm_s}"
     )
+
+
+def _replication_line(node: dict, label: str) -> Optional[str]:
+    """The per-node replication pane row: role / epoch / WAL positions
+    / standby lag. None when the node has no ``/replication`` endpoint
+    or HA is not configured (pre-replication managers stay
+    renderable)."""
+    rep = node.get("replication")
+    if not isinstance(rep, dict) or rep.get("role") is None:
+        return None
+    role = str(rep.get("role", "?"))
+    epoch = rep.get("epoch")
+    wal = rep.get("wal") or {}
+    parts = [
+        f"  replication[{label}]: role={role}",
+        f"epoch={_fmt_num(epoch, '{:.0f}')}",
+    ]
+    if role == "active":
+        targets = wal.get("targets") or {}
+        shipped = wal.get("min_shipped_offset")
+        parts.append(f"standbys={len(targets)}")
+        parts.append(f"shipped_offset={_fmt_num(shipped, '{:.0f}')}")
+    else:
+        parts.append(
+            f"applied_offset={_fmt_num(wal.get('applied_offset'), '{:.0f}')}")
+        parts.append(f"lag={_fmt_num(wal.get('lag_s'))}s")
+    lease = rep.get("lease") or {}
+    if lease:
+        parts.append(f"lease_holder={lease.get('holder', '?')}")
+    return "  ".join(parts)
 
 
 def firing_alerts(state: dict, severity: Optional[str] = None) -> List[dict]:
@@ -280,6 +312,10 @@ def render(state: dict, color: bool = True) -> str:
             lines.append(paint("slow", "  !! recompile storm in the "
                                        "last round — check input "
                                        "shape churn"))
+
+    rep_line = _replication_line(root, "root")
+    if rep_line:
+        lines.append(rep_line)
 
     alert_lines = _alert_pane(state, paint)
     if alert_lines:

@@ -75,6 +75,7 @@ broadcast anchor.
 from __future__ import annotations
 
 import asyncio
+import base64
 import json
 import logging
 import math
@@ -96,7 +97,7 @@ from baton_tpu.obs import alerts as obs_alerts
 from baton_tpu.obs import compute as obs_compute
 from baton_tpu.obs import forensics as obs_forensics
 from baton_tpu.ops import aggregation as agg
-from baton_tpu.server import wire
+from baton_tpu.server import replication, wire
 from baton_tpu.server.blobs import BlobStore
 from baton_tpu.server.fleet import ClientLedger
 from baton_tpu.server.ingest import ChunkSession, IngestPipeline
@@ -287,6 +288,18 @@ class Experiment:
         trace_spool_max_age_s: float = 3600.0,
         trace_spool_max_files: int = 512,
         jsonl_max_bytes: Optional[int] = 64 * 1024 * 1024,
+        ha_role: Optional[str] = None,
+        ha_replica_id: Optional[str] = None,
+        ha_standbys: Optional[list] = None,
+        ha_replicas: Optional[dict] = None,
+        ha_lease_s: float = 3.0,
+        ha_ship_interval_s: float = 0.5,
+        ha_promote_grace_s: float = 1.0,
+        ha_auto_promote: bool = True,
+        ha_token: Optional[str] = None,
+        chunk_spill_dir: Optional[str] = None,
+        journal_payloads: bool = True,
+        journal_payload_max_bytes: Optional[int] = 8 * 1024 * 1024,
     ):
         """``aggregator``: ``"mean"`` (sample-weighted FedAvg, reference
         manager.py:119-126), or Byzantine-robust ``"trimmed:<ratio>"`` /
@@ -425,7 +438,26 @@ class Experiment:
         ``trace_spool_max_files``, exempting traces referenced by
         retained forensics bundles) and rotates ``rounds.jsonl`` /
         ``clients.jsonl`` once they exceed ``jsonl_max_bytes``
-        (``None`` disables rotation)."""
+        (``None`` disables rotation).
+
+        Replication (server/replication.py): ``ha_role`` opts this
+        replica into the control-plane HA protocol — ``"active"`` ships
+        its journal to ``ha_standbys`` (base URLs) and renews an
+        epoch-numbered lease every ``ha_ship_interval_s``;
+        ``"standby"`` applies shipped WAL segments at
+        ``POST /{name}/wal_segment``, refuses all serving routes 503,
+        and (with ``ha_auto_promote``) promotes itself once the lease
+        has been expired for ``ha_promote_grace_s``. Both roles require
+        ``journal_path``. ``ha_replicas`` (``{replica_id: base_url}``)
+        additionally builds the :class:`ExperimentTopology` hash-ring
+        assignment of experiments to replicas; a heartbeat landing on
+        the wrong replica gets a 307 redirect carrying the refreshed
+        topology map. ``ha_token`` authenticates wal_segment POSTs.
+        ``chunk_spill_dir`` spills chunk-upload sessions to disk so a
+        restart keeps each committed prefix; ``journal_payloads``
+        journals accepted update payloads (bodies up to
+        ``journal_payload_max_bytes``) so a resumed round reuses
+        already-delivered updates instead of re-training reporters."""
         if secure_agg and allow_pickle:
             raise ValueError(
                 "secure_agg is incompatible with allow_pickle: reference-"
@@ -522,12 +554,48 @@ class Experiment:
                 f"got {recovery_policy!r}"
             )
         self.recovery_policy = recovery_policy
+        if ha_role not in (None, "active", "standby"):
+            raise ValueError(
+                f"ha_role must be None, 'active' or 'standby', got {ha_role!r}"
+            )
+        if ha_role is not None and journal_path is None:
+            raise ValueError(
+                "ha_role requires journal_path: the WAL is the "
+                "replication channel"
+            )
+        self.ha_role = ha_role
+        self.ha_replica_id = ha_replica_id or name
+        self.ha_lease_s = float(ha_lease_s)
+        self.ha_ship_interval_s = float(ha_ship_interval_s)
+        self.ha_promote_grace_s = float(ha_promote_grace_s)
+        self.ha_auto_promote = bool(ha_auto_promote)
+        self.ha_token = ha_token
+        self.ha_epoch = 0
+        self._ha_standbys = [
+            u.rstrip("/") for u in (ha_standbys or [])
+        ]
+        self._ha_replica_urls = {
+            str(rid): str(url).rstrip("/")
+            for rid, url in (ha_replicas or {}).items()
+        }
+        self._wal_shipper = None
+        self._wal_receiver = None
+        self._ha_lease: Optional[dict] = None
+        self._recovered_ha_epoch = 0
+        self.journal_payloads = bool(journal_payloads)
+        self.journal_payload_max_bytes = (
+            None
+            if journal_payload_max_bytes is None
+            else int(journal_payload_max_bytes)
+        )
         self.name = name
         self.app = app
         self.model = model
         self.params = params if params is not None else model.init(jax.random.key(rng_seed))
         self.journal = None
-        if journal_path is not None:
+        self._journal_path = journal_path
+        self._journal_fsync = journal_fsync
+        if journal_path is not None and ha_role != "standby":
             from baton_tpu.server.journal import Journal
 
             self.journal = Journal(journal_path, fsync=journal_fsync)
@@ -538,6 +606,18 @@ class Experiment:
             name, round_timeout=round_timeout, journal=self.journal
         )
         self.metrics = metrics or Metrics()
+        # HA wiring (server/replication.py): a standby owns no Journal —
+        # its journal FILE is written verbatim by the WalReceiver and
+        # only becomes a live Journal at promote()
+        if ha_role == "standby":
+            self._wal_receiver = replication.WalReceiver(
+                journal_path, metrics=self.metrics
+            )
+        self._ha_topology = (
+            replication.ExperimentTopology(sorted(self._ha_replica_urls))
+            if self._ha_replica_urls
+            else None
+        )
         # Distributed round tracing. The service label is
         # per-INCARNATION (random suffix): a chaos test runs a killed
         # manager and its replacement in one OS process, and the trace
@@ -606,7 +686,14 @@ class Experiment:
             else None
         )
         # chunked resumable uploads: (client_id, update_id) → ChunkSession
+        self.chunk_spill_dir = chunk_spill_dir
         self._chunks: Dict[tuple, ChunkSession] = {}
+        if chunk_spill_dir is not None:
+            self._chunks = ChunkSession.restore_sessions(chunk_spill_dir)
+            if self._chunks:
+                self.metrics.inc(
+                    "chunk_sessions_restored", float(len(self._chunks))
+                )
         # round-robin shard cursor for fold_shards>1 (reset per round)
         self._fold_rr = 0
         # client_ids mid-acceptance across an off-loop decompress await
@@ -640,6 +727,24 @@ class Experiment:
         self._recovery_task = None
         if self.journal is not None:
             self._recover_from_journal(secure_agg)
+        if self.ha_role == "active":
+            # claim leadership: epoch strictly above anything the
+            # journal has seen, fencing every prior incarnation
+            self.ha_epoch = self._recovered_ha_epoch + 1
+            self._ha_lease = replication.make_lease(
+                self.ha_epoch, self.ha_replica_id, self.ha_lease_s
+            )
+            self.journal.append("ha_lease", **self._ha_lease)
+            if self._ha_standbys:
+                self._wal_shipper = replication.WalShipper(
+                    name,
+                    self.journal,
+                    self._ha_standbys,
+                    self.ha_replica_id,
+                    lambda: self._session,
+                    token=self.ha_token,
+                    metrics=self.metrics,
+                )
         self.allow_pickle = allow_pickle
         self.secure_agg = secure_agg
         self.secure_scale_bits = secure_scale_bits
@@ -668,6 +773,9 @@ class Experiment:
         callback URLs) and the round counter, and stage any in-flight
         round for :meth:`_resume_round` once the event loop is up."""
         rec = self.journal.recover()
+        self._recovered_ha_epoch = max(
+            self._recovered_ha_epoch, rec.ha_epoch
+        )
         if rec.empty:
             return
         for cid, c in rec.clients.items():
@@ -699,14 +807,25 @@ class Experiment:
             # (self._secure_round) died with the process, so surviving
             # masked uploads could not be unmasked anyway
             reason = "secure_agg" if secure_agg else "recovery_policy"
+            round_name = rec.open_round["round_name"]
             self.rounds._journal(
-                "round_aborted",
-                round_name=rec.open_round["round_name"], reason=reason,
+                "round_aborted", round_name=round_name, reason=reason,
             )
             self.metrics.inc("recovery_rounds_aborted")
+            # the abort is an SLO event, not just a log line: land it in
+            # rounds.jsonl and alerts.jsonl so a failover that kills a
+            # secure round is auditable (secure mask/share state is
+            # deliberately never shipped — forward secrecy over resume)
+            self._finish_round_obs(round_name, f"aborted:recovery_{reason}")
+            self.alerts.log_event({
+                "event": "recovery_round_aborted",
+                "round": round_name,
+                "reason": reason,
+                "ts": round(time.time(), 6),
+            })
             _log.warning(
                 "%s: in-flight round %s aborted on recovery (%s)",
-                self.name, rec.open_round["round_name"], reason,
+                self.name, round_name, reason,
             )
             return
         self._recovered_round = rec.open_round
@@ -770,8 +889,40 @@ class Experiment:
             )
             body = json.dumps(envelope).encode()
             ctype = "application/json"
+        payloads = dict(info.get("payloads") or {})
+        rebroadcast = []
         self._broadcasting = True
         try:
+            # journaled-payload replay FIRST: a participant whose
+            # accepted update rode the WAL re-joins with its ORIGINAL
+            # bytes re-ingested — zero re-training, zero retransfer.
+            # Only participants with no journaled payload get the
+            # re-announce below.
+            for cid in cohort:
+                p = payloads.get(cid)
+                if not isinstance(p, dict) or not p.get("data"):
+                    rebroadcast.append(cid)
+                    continue
+                try:
+                    raw = base64.b64decode(p["data"])
+                    self.rounds.client_start(cid)
+                    resp = await self._ingest_update(
+                        cid, raw, p.get("content_type")
+                    )
+                    ok = resp.status == 200
+                except (asyncio.CancelledError, KeyboardInterrupt):
+                    raise
+                except Exception:
+                    ok = False
+                if ok:
+                    self.metrics.inc("recovery_updates_reused")
+                else:
+                    self.metrics.inc("recovery_payload_replays_failed")
+                    rebroadcast.append(cid)
+            if rebroadcast:
+                self.metrics.inc(
+                    "recovery_rebroadcasts", float(len(rebroadcast))
+                )
             # recovery re-announce is a span of the ORIGINAL round's
             # trace: the new incarnation's spans land in the same trace
             # id (derived from the round name), so an exported trace
@@ -781,10 +932,13 @@ class Experiment:
                 trace_id=trace_id,
                 parent_id=tracing.root_span_id(trace_id),
                 round=round_name,
-                cohort=len(cohort),
+                cohort=len(rebroadcast),
             ):
                 await bounded_gather(
-                    *[self._notify_client(cid, body, ctype) for cid in cohort],
+                    *[
+                        self._notify_client(cid, body, ctype)
+                        for cid in rebroadcast
+                    ],
                     limit=self.fanout_concurrency,
                 )
         finally:
@@ -802,6 +956,145 @@ class Experiment:
             )
             return
         self._maybe_finish()
+
+    # -- control-plane replication (server/replication.py) -------------
+    async def _ha_tick(self) -> None:
+        """One replication heartbeat. Active: renew + journal the lease,
+        ship the WAL tail to every standby. Standby: promote once the
+        active's lease has been expired past the grace window."""
+        if self.ha_role == "active":
+            self._ha_lease = replication.make_lease(
+                self.ha_epoch, self.ha_replica_id, self.ha_lease_s
+            )
+            self.journal.append("ha_lease", **self._ha_lease)
+            self.metrics.inc("ha_lease_renewals")
+            if self._wal_shipper is not None:
+                await self._wal_shipper.ship_once(
+                    self.ha_epoch, self._ha_lease
+                )
+        elif self.ha_role == "standby" and self._wal_receiver is not None:
+            if self.ha_auto_promote and self._wal_receiver.lease_expired(
+                self.ha_promote_grace_s
+            ):
+                await self.promote()
+
+    async def promote(self) -> bool:
+        """Standby → active: stop accepting segments, replay the shipped
+        WAL into live registry/round state, claim the next epoch, and
+        start serving (resuming any in-flight round with its journaled
+        payloads). Idempotent — a second call is a no-op."""
+        if self.ha_role != "standby" or self._wal_receiver is None:
+            return False
+        receiver = self._wal_receiver
+        # fence FIRST: from this instant every wal_segment POST from the
+        # old active answers 409 stale_epoch, so nothing can mutate the
+        # journal file underneath the replay below
+        receiver.closed = True
+        from baton_tpu.server.journal import Journal
+
+        self.journal = Journal(self._journal_path, fsync=self._journal_fsync)
+        self.registry.journal = self.journal
+        self.rounds.journal = self.journal
+        self._recover_from_journal(self.secure_agg)
+        self.ha_epoch = (
+            max(self._recovered_ha_epoch, receiver.epoch) + 1
+        )
+        self.ha_role = "active"
+        self._ha_lease = replication.make_lease(
+            self.ha_epoch, self.ha_replica_id, self.ha_lease_s
+        )
+        self.journal.append("ha_lease", **self._ha_lease)
+        if self._ha_topology is not None:
+            holder = (receiver.lease or {}).get("holder")
+            if holder:
+                self._ha_topology.mark_dead(str(holder))
+            self._ha_topology.mark_alive(self.ha_replica_id)
+        if self._ha_standbys:
+            self._wal_shipper = replication.WalShipper(
+                self.name,
+                self.journal,
+                self._ha_standbys,
+                self.ha_replica_id,
+                lambda: self._session,
+                token=self.ha_token,
+                metrics=self.metrics,
+            )
+        self.metrics.inc("ha_promotions")
+        _log.warning(
+            "%s: standby %s promoted to active at epoch %d "
+            "(wal generation=%s applied_offset=%d)",
+            self.name, self.ha_replica_id, self.ha_epoch,
+            receiver.generation, receiver.offset,
+        )
+        if self._recovered_round is not None:
+            await self._resume_round()
+        return True
+
+    def _standby_refusal(self) -> Optional[web.Response]:
+        """503 for serving routes while this replica is a standby — the
+        client's failover list (or the 307 topology) sends it to the
+        active; a standby must never mutate round state."""
+        if self.ha_role != "standby":
+            return None
+        return web.json_response(
+            {"error": "Standby", "epoch": self.ha_epoch}, status=503
+        )
+
+    async def handle_wal_segment(self, request: web.Request) -> web.Response:
+        """``POST /{name}/wal_segment`` — the replication ingress."""
+        if self.ha_token and (
+            request.headers.get(replication.HA_TOKEN_HEADER) != self.ha_token
+        ):
+            return web.json_response({"error": "Unauthorized"}, status=401)
+        try:
+            seg = await read_json_capped(request, self.max_upload_bytes)
+        except BodyTooLarge:
+            return web.json_response({"error": "Too Large"}, status=413)
+        except (ValueError, TypeError):
+            return web.json_response({"error": "Bad Segment"}, status=400)
+        if not isinstance(seg, dict):
+            return web.json_response({"error": "Bad Segment"}, status=400)
+        if self._wal_receiver is not None and not self._wal_receiver.closed:
+            status, body = self._wal_receiver.apply(seg)
+            return web.json_response(body, status=status)
+        # active (or promoted ex-standby): any segment at or below our
+        # epoch is a zombie's — the 409 here is the split-brain fence
+        try:
+            seg_epoch = int(seg.get("epoch", 0))
+        except (TypeError, ValueError):
+            return web.json_response({"error": "Bad Segment"}, status=400)
+        if seg_epoch <= self.ha_epoch:
+            self.metrics.inc("wal_segments_refused_stale")
+            return web.json_response(
+                {"error": "stale_epoch", "epoch": self.ha_epoch}, status=409
+            )
+        return web.json_response({"error": "not_standby"}, status=409)
+
+    async def handle_replication(self, request: web.Request) -> web.Response:
+        """``GET /{name}/replication`` — role/epoch/WAL positions for
+        the ops console's replication pane."""
+        wal: dict = {}
+        if self._wal_shipper is not None:
+            wal = {
+                "generation": self.journal.generation,
+                "targets": self._wal_shipper.positions(),
+                "min_shipped_offset": self._wal_shipper.min_shipped_offset(),
+            }
+        elif self._wal_receiver is not None:
+            wal = self._wal_receiver.status()
+        body = {
+            "role": self.ha_role,
+            "replica": self.ha_replica_id,
+            "epoch": self.ha_epoch,
+            "lease": (
+                self._ha_lease
+                if self.ha_role == "active"
+                else (self._wal_receiver.lease if self._wal_receiver else None)
+            ),
+            "wal": wal,
+            "topology": self._ha_replica_urls or None,
+        }
+        return web.json_response(json_clean(body))
 
     # ------------------------------------------------------------------
     async def _start_background(self, app=None) -> None:
@@ -832,6 +1125,11 @@ class Experiment:
                 self._retention_tick, self.retention_interval_s
             )
             self._background.append(retention.start())
+        if self.ha_role is not None:
+            ha = PeriodicTask(
+                self._ha_tick, max(self.ha_ship_interval_s, 0.05)
+            )
+            self._background.append(ha.start())
         if self._recovered_round is not None:
             self._recovery_task = asyncio.get_running_loop().create_task(
                 self._resume_round()
@@ -973,6 +1271,9 @@ class Experiment:
             f"/{self.name}/rounds/{{rid}}/trace", self.handle_round_trace
         )
         r.add_post(f"/{self.name}/trace_spans", self.handle_trace_spans)
+        # control-plane replication: WAL ingress + status pane
+        r.add_post(f"/{self.name}/wal_segment", self.handle_wal_segment)
+        r.add_get(f"/{self.name}/replication", self.handle_replication)
 
     # -- v2 pull data plane --------------------------------------------
     _RANGE_RE = re.compile(r"bytes=(\d+)-(\d*)$")
@@ -1026,6 +1327,9 @@ class Experiment:
 
     # -- membership ----------------------------------------------------
     async def handle_register(self, request: web.Request) -> web.Response:
+        refusal = self._standby_refusal()
+        if refusal is not None:
+            return refusal
         try:
             data = await read_json_capped(request)
         except BodyTooLarge as exc:
@@ -1042,6 +1346,9 @@ class Experiment:
         )
 
     async def handle_heartbeat(self, request: web.Request) -> web.Response:
+        refusal = self._standby_refusal()
+        if refusal is not None:
+            return refusal
         try:
             data = await read_json_capped(request)
         except BodyTooLarge as exc:
@@ -1054,6 +1361,24 @@ class Experiment:
             self.registry.heartbeat(data.get("client_id"), data.get("key"))
         except (UnknownClient, AuthError):
             return web.json_response({"err": "Invalid Client"}, status=401)
+        # experiment sharding: a heartbeat landing on the wrong replica
+        # learns the owner lazily — 307 + the refreshed topology map
+        # (the data the worker needs to retarget every other call too)
+        if self._ha_topology is not None:
+            owner = self._ha_topology.assign(self.name)
+            if owner is not None and owner != self.ha_replica_id:
+                url = self._ha_replica_urls.get(owner)
+                if url:
+                    self.metrics.inc("heartbeats_redirected")
+                    return web.json_response(
+                        {
+                            "url": f"{url}/{self.name}/",
+                            "replica": owner,
+                            "topology": self._ha_replica_urls,
+                        },
+                        status=307,
+                        headers={"Location": f"{url}/{self.name}/heartbeat"},
+                    )
         return web.json_response("OK")
 
     async def handle_clients(self, request: web.Request) -> web.Response:
@@ -1061,6 +1386,9 @@ class Experiment:
 
     # -- rounds --------------------------------------------------------
     async def handle_start_round(self, request: web.Request) -> web.Response:
+        refusal = self._standby_refusal()
+        if refusal is not None:
+            return refusal
         try:
             n_epoch = int(request.query["n_epoch"])
         except KeyError:
@@ -1076,6 +1404,9 @@ class Experiment:
         return web.json_response(status)
 
     async def handle_end_round(self, request: web.Request) -> web.Response:
+        refusal = self._standby_refusal()
+        if refusal is not None:
+            return refusal
         if self._secure_round is not None:
             await self._end_round_secure()
         else:
@@ -1105,6 +1436,33 @@ class Experiment:
         snap["gauges"]["dh_cache_size"] = float(dh["size"])
         snap["gauges"]["dh_cache_hits"] = float(dh["hits"])
         snap["gauges"]["dh_cache_misses"] = float(dh["misses"])
+        if self.ha_role is not None:
+            g = snap["gauges"]
+            g["replication_epoch"] = float(self.ha_epoch)
+            g["replication_role_active"] = float(self.ha_role == "active")
+            g["replication_standbys"] = float(len(self._ha_standbys))
+            if self._wal_shipper is not None:
+                g["replication_wal_shipped_offset"] = float(
+                    self._wal_shipper.min_shipped_offset()
+                )
+            recv = self._wal_receiver
+            if recv is not None and self.ha_role == "standby":
+                g["replication_wal_applied_offset"] = float(recv.offset)
+                lag = recv.lag_s()
+                if lag is not None:
+                    g["replication_wal_lag_s"] = float(lag)
+            lease = (
+                self._ha_lease
+                if self.ha_role == "active"
+                else (recv.lease if recv is not None else None)
+            )
+            if isinstance(lease, dict):
+                try:
+                    g["replication_lease_remaining_s"] = float(
+                        lease.get("expires", 0.0)
+                    ) - time.time()
+                except (TypeError, ValueError):
+                    pass
         return snap
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
@@ -1434,6 +1792,9 @@ class Experiment:
         )
 
     async def handle_update(self, request: web.Request) -> web.Response:
+        refusal = self._standby_refusal()
+        if refusal is not None:
+            return refusal
         try:
             client_id = self.registry.verify(
                 request.query.get("client_id", ""), request.query.get("key", "")
@@ -1526,6 +1887,37 @@ class Experiment:
                 compressed
 
         return decode
+
+    def _journal_payload(
+        self, client_id: str, round_name: str, body: bytes, content_type
+    ) -> None:
+        """Journal an accepted update's wire bytes so a successor (same
+        process restarted, or a promoted standby replaying shipped WAL)
+        can re-ingest the update instead of asking the worker to
+        re-train: the worker's one-slot outbox dropped the payload on
+        our 200 ack, so the journal is the ONLY copy that survives us.
+        Called at the acceptance point, after ``client_end`` journaled
+        ``update_accepted`` — replay pairs the two."""
+        if (
+            self.journal is None
+            or not self.journal_payloads
+            or not body
+        ):
+            return
+        if (
+            self.journal_payload_max_bytes is not None
+            and len(body) > self.journal_payload_max_bytes
+        ):
+            self.metrics.inc("journal_payloads_skipped_large")
+            return
+        self.journal.append(
+            "update_payload",
+            round_name=round_name,
+            client_id=client_id,
+            content_type=str(content_type or "application/octet-stream"),
+            data=base64.b64encode(body).decode("ascii"),
+        )
+        self.metrics.inc("journal_payloads_journaled")
 
     async def _ingest_update(
         self,
@@ -1662,6 +2054,7 @@ class Experiment:
             # enter the running sums.
             response["streamed"] = True
             self.rounds.client_end(client_id, response)
+            self._journal_payload(client_id, round_name, body, content_type)
             self.registry.record_update(client_id, round_name)
             self.metrics.inc("updates_received")
             if compressed:
@@ -1719,6 +2112,11 @@ class Experiment:
         response["state_dict"] = tensors
         del tensors
         self.rounds.client_end(client_id, response)
+        if not response["masked"]:
+            # masked (secure-agg) bodies are useless to a successor —
+            # the mask directory dies with this process (see the
+            # recovery abort policy) — so only plaintext payloads ship
+            self._journal_payload(client_id, round_name, body, content_type)
         self.registry.record_update(client_id, round_name)
         self.metrics.inc("updates_received")
         self._maybe_finish()
@@ -1886,6 +2284,9 @@ class Experiment:
         resumes from there (the manager is authoritative). The final
         chunk's response IS the update's acceptance response — 200 means
         accepted exactly as a single POST would have been."""
+        refusal = self._standby_refusal()
+        if refusal is not None:
+            return refusal
         try:
             client_id = self.registry.verify(
                 request.query.get("client_id", ""), request.query.get("key", "")
@@ -1917,13 +2318,15 @@ class Experiment:
             if len(self._chunks) >= self.max_chunk_sessions:
                 return self._reject_429("Too Many Chunk Sessions")
             sess = ChunkSession(
-                client_id=client_id, update_id=update_id, total=total
+                client_id=client_id, update_id=update_id, total=total,
+                spill_dir=self.chunk_spill_dir,
             )
             self._chunks[key] = sess
             self.metrics.set_gauge("chunk_sessions_active", len(self._chunks))
         if sess.total != total:
             # inconsistent framing poisons the session — drop it
             self._chunks.pop(key, None)
+            sess.discard()
             self.metrics.set_gauge("chunk_sessions_active", len(self._chunks))
             return web.json_response({"err": "Inconsistent Total"}, status=400)
         if sess.busy:
@@ -1950,10 +2353,11 @@ class Experiment:
                 # first-frame sniff: don't buffer max_upload_bytes of a
                 # payload that is destined for "Bad Payload" anyway
                 self._chunks.pop(key, None)
+                sess.discard()
                 self.metrics.set_gauge(
                     "chunk_sessions_active", len(self._chunks))
                 return web.json_response({"err": "Bad Payload"}, status=400)
-            sess.buf.extend(chunk)
+            sess.extend(chunk)
             self.metrics.inc("bytes_uploaded", len(chunk))
             self.metrics.inc("chunk_bytes_received", len(chunk))
             if sess.offset < sess.total:
@@ -1963,7 +2367,7 @@ class Experiment:
             )
             if ctx is None:
                 resp = await self._ingest_update(
-                    client_id, bytes(sess.buf), wire.CONTENT_TYPE
+                    client_id, sess.payload(), wire.CONTENT_TYPE
                 )
             else:
                 # the FINAL chunk's traceparent parents the assembly
@@ -1973,7 +2377,7 @@ class Experiment:
                     client=client_id, bytes=sess.total, chunked=True,
                 ):
                     resp = await self._ingest_update(
-                        client_id, bytes(sess.buf), wire.CONTENT_TYPE
+                        client_id, sess.payload(), wire.CONTENT_TYPE
                     )
         finally:
             sess.busy = False
@@ -1982,6 +2386,7 @@ class Experiment:
             # retry re-sends only the (empty) final frame, not 100 MB
             return resp
         self._chunks.pop(key, None)
+        sess.discard()
         self.metrics.set_gauge("chunk_sessions_active", len(self._chunks))
         if resp.status == 200:
             self.metrics.inc("chunked_uploads_assembled")
@@ -3106,6 +3511,10 @@ class Experiment:
                 "loss_history": [
                     float(x) for x in self.rounds.loss_history
                 ],
+                # leadership must survive compaction: a standby that
+                # catches up from this snapshot (or a restart that
+                # replays it) must not mint an epoch below the fence
+                "ha_epoch": self.ha_epoch,
             }
         )
 

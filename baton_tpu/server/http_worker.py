@@ -149,6 +149,7 @@ class ExperimentWorker:
         train_time_scale: float = 1.0,
         edge: Optional[str] = None,
         edge_retry_s: float = 10.0,
+        failover: Optional[list] = None,
     ):
         """``compress`` turns on sparse round-delta uploads
         (ops/compression.py): ``"topk:0.05"`` keeps the top 5% of delta
@@ -192,6 +193,16 @@ class ExperimentWorker:
         of stalling rounds. Masked (secure-aggregation) uploads always
         go direct regardless.
 
+        ``failover``: additional root ``"host:port"`` addresses (warm
+        standbys / other replicas, server/replication.py). Any direct-
+        root transport failure or 503 (a standby refusing to serve)
+        rotates to the next address; a heartbeat answered 307 (the
+        experiment was resharded to another replica) retargets every
+        subsequent call to the redirect's URL. The at-least-once outbox
+        then redelivers the parked update to the new active — which
+        either reuses the journaled copy (dedup by update_id) or
+        ingests this one.
+
         ``train_time_scale``: simulated device-speed multiplier, >= 1.0.
         After real training finishes, the worker idles inside the
         ``local_train`` span until the round's compute has taken
@@ -229,7 +240,15 @@ class ExperimentWorker:
         self.port = port
         self.worker_host = worker_host
         self.manager = manager
-        self.root_url = f"http://{manager}/{self.name}/"
+        # direct-root route ring: the configured manager first, then the
+        # failover replicas; _root_idx rotates on transport failure/503,
+        # _root_override (full base URL) is pinned by a 307 redirect
+        self._root_urls = [
+            f"http://{m}/{self.name}/"
+            for m in [manager] + [str(x) for x in (failover or []) if x]
+        ]
+        self._root_idx = 0
+        self._root_override: Optional[str] = None
         self.edge_url = f"http://{edge}/{self.name}/" if edge else None
         self.edge_retry_s = float(edge_retry_s)
         # monotonic deadline until which the edge route is considered
@@ -369,6 +388,37 @@ class ExperimentWorker:
         self._edge_down_until = time.monotonic() + self.edge_retry_s
         self.metrics.inc("edge_route_fallbacks")
 
+    @property
+    def root_url(self) -> str:
+        """The current direct-root base URL: a 307-learned owner when
+        one is pinned, else the failover ring's current entry."""
+        return self._root_override or self._root_urls[self._root_idx]
+
+    def _root_failed(self) -> None:
+        """Rotate the direct-root route to the next replica. A 307
+        override is dropped first (the owner it named is the thing that
+        just failed); with a single configured root this is a no-op and
+        the caller's backoff retries the same address."""
+        if self._root_override is not None:
+            self._root_override = None
+        elif len(self._root_urls) > 1:
+            self._root_idx = (self._root_idx + 1) % len(self._root_urls)
+        else:
+            return
+        self.metrics.inc("root_failovers")
+
+    def _follow_redirect(self, data) -> bool:
+        """Pin the direct-root route to a 307 redirect's owner URL (the
+        topology reassignment contract, server/replication.py)."""
+        if not isinstance(data, dict):
+            return False
+        url = data.get("url")
+        if not isinstance(url, str) or not url.startswith("http"):
+            return False
+        self._root_override = url if url.endswith("/") else url + "/"
+        self.metrics.inc("root_redirects_followed")
+        return True
+
     # -- membership ----------------------------------------------------
     async def register_with_manager(self) -> None:
         if self._register_lock.locked():
@@ -387,6 +437,15 @@ class ExperimentWorker:
                 url = self.manager_url + "register"
                 try:
                     async with self._session.get(url, json=payload) as resp:
+                        if resp.status != 200:
+                            # a standby answers 503; anything non-200
+                            # here means "not this replica" — rotate the
+                            # root ring and retry (KeyError-ing on the
+                            # error body would kill registration for
+                            # good)
+                            raise aiohttp.ClientResponseError(
+                                resp.request_info, (), status=resp.status
+                            )
                         data = await resp.json()
                         self.client_id = data["client_id"]
                         self.key = data["key"]
@@ -395,6 +454,8 @@ class ExperimentWorker:
                 except aiohttp.ClientError:
                     if via_edge:
                         self._edge_failed()
+                    else:
+                        self._root_failed()
                     await asyncio.sleep(backoff)
                     backoff = min(backoff * 2, MAX_BACKOFF)
             # (Re)start the heartbeat loop — unless we're being called
@@ -412,6 +473,7 @@ class ExperimentWorker:
 
     async def heartbeat(self) -> None:
         backoff = 1.0
+        redirects = 0
         while True:
             # URL per attempt, not once at the top: a dead edge marked
             # down inside this loop must not pin every retry to it
@@ -425,18 +487,38 @@ class ExperimentWorker:
                     async with self._session.get(
                         url,
                         json={"client_id": self.client_id, "key": self.key},
+                        allow_redirects=False,
                     ) as resp:
                         status = resp.status
+                        data = None
+                        if status == 307:
+                            try:
+                                data = await resp.json()
+                            except (aiohttp.ContentTypeError, ValueError):
+                                data = None
                 if status == 200:
                     self._last_hb_rtt = time.perf_counter() - t_hb0
                     return
                 if status == 401:
                     # manager restarted or culled us: rejoin
                     return await self.register_with_manager()
+                if status == 307:
+                    # the experiment was resharded: retarget the direct
+                    # root route and heartbeat the owner right away
+                    # (bounded — a 307 ping-pong falls into the backoff)
+                    if self._follow_redirect(data) and redirects < 2:
+                        redirects += 1
+                        continue
+                if status == 503 and not via_edge:
+                    # a standby: our active is elsewhere — rotate the
+                    # ring, then take the backoff (an un-promoted fleet
+                    # answering 503 everywhere must not spin hot)
+                    self._root_failed()
             except aiohttp.ClientError:
                 if via_edge:
                     self._edge_failed()
                     continue  # retry direct immediately, no backoff
+                self._root_failed()
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, MAX_BACKOFF)
 
@@ -1607,6 +1689,8 @@ class ExperimentWorker:
                     )
                 if status is None and via_edge:
                     self._edge_failed()
+                elif (status is None or status == 503) and not via_edge:
+                    self._root_failed()
                 return status, retry_after
             url = (
                 base_url
@@ -1630,12 +1714,18 @@ class ExperimentWorker:
                         # unknown): mark the route down so the outbox's
                         # next attempt delivers direct to the root
                         self._edge_failed()
+                    if resp.status == 503 and not via_edge:
+                        # a standby refusing to serve: rotate the root
+                        # ring so the backoff retry lands on the active
+                        self._root_failed()
                     return resp.status, self._retry_after_s(resp)
             except (aiohttp.ClientError, asyncio.TimeoutError):
                 # manager down; the backoff loop keeps trying
                 up_sp.set(status=None)
                 if via_edge:
                     self._edge_failed()
+                else:
+                    self._root_failed()
                 return None, None
 
     async def _post_update_chunked(

@@ -48,6 +48,9 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import hashlib
+import json
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -224,12 +227,19 @@ class IngestPipeline:
 class ChunkSession:
     """Server-side assembly state for one chunked resumable upload.
 
-    The committed prefix is ``len(buf)``; a PUT whose ``offset`` doesn't
-    equal it gets ``409 {"offset": committed}`` and the worker resyncs —
-    the manager's committed offset is authoritative. ``busy`` rejects
-    interleaved PUTs for the same session (a client must send chunks
-    sequentially; a retry racing its own zombie connection must not
-    corrupt the buffer).
+    The committed prefix is :attr:`offset`; a PUT whose ``offset``
+    doesn't equal it gets ``409 {"offset": committed}`` and the worker
+    resyncs — the manager's committed offset is authoritative. ``busy``
+    rejects interleaved PUTs for the same session (a client must send
+    chunks sequentially; a retry racing its own zombie connection must
+    not corrupt the buffer).
+
+    With a ``spill_dir`` the body lives in a ``<digest>.part`` file
+    (plus a ``.meta`` sidecar naming the session) instead of a
+    process-memory bytearray: a manager restart rescans the directory
+    (:meth:`restore_sessions`) and keeps every committed prefix — the
+    worker's next offset probe resumes mid-upload instead of starting
+    over — and upload buffering stops being bounded by RAM.
     """
 
     client_id: str
@@ -237,7 +247,97 @@ class ChunkSession:
     total: int
     buf: bytearray = dataclasses.field(default_factory=bytearray)
     busy: bool = False
+    spill_dir: Optional[str] = None
+    _spill_size: int = 0
+
+    def __post_init__(self) -> None:
+        if self.spill_dir is None:
+            return
+        os.makedirs(self.spill_dir, exist_ok=True)
+        base = self._spill_base(self.spill_dir, self.client_id,
+                                self.update_id)
+        self._part_path = base + ".part"
+        meta_path = base + ".meta"
+        if not os.path.exists(meta_path):
+            tmp = meta_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"client_id": self.client_id,
+                           "update_id": self.update_id,
+                           "total": self.total}, fh)
+            os.replace(tmp, meta_path)
+        try:
+            self._spill_size = os.path.getsize(self._part_path)
+        except OSError:
+            self._spill_size = 0
+
+    @staticmethod
+    def _spill_base(spill_dir: str, client_id: str, update_id: str) -> str:
+        digest = hashlib.sha256(
+            f"{client_id}\x00{update_id}".encode("utf-8")
+        ).hexdigest()[:24]
+        return os.path.join(spill_dir, digest)
 
     @property
     def offset(self) -> int:
+        if self.spill_dir is not None:
+            return self._spill_size
         return len(self.buf)
+
+    def extend(self, chunk: bytes) -> None:
+        if self.spill_dir is None:
+            self.buf.extend(chunk)
+            return
+        with open(self._part_path, "ab") as fh:
+            fh.write(chunk)
+            fh.flush()
+        self._spill_size += len(chunk)
+
+    def payload(self) -> bytes:
+        if self.spill_dir is None:
+            return bytes(self.buf)
+        try:
+            with open(self._part_path, "rb") as fh:
+                return fh.read()
+        except OSError:
+            return b""
+
+    def discard(self) -> None:
+        """Release the session's storage (no-op for the in-memory
+        path — the bytearray dies with the object)."""
+        if self.spill_dir is None:
+            return
+        base = self._spill_base(self.spill_dir, self.client_id,
+                                self.update_id)
+        for suffix in (".part", ".meta"):
+            try:
+                os.remove(base + suffix)
+            except OSError:
+                pass
+
+    @classmethod
+    def restore_sessions(cls, spill_dir: str) -> dict:
+        """Rebuild the session table from a spill directory after a
+        restart: ``{(client_id, update_id): ChunkSession}`` with each
+        offset recomputed from its ``.part`` file's size — the file IS
+        the committed prefix. Unreadable sidecars are skipped (a crash
+        mid-create loses only that one upload's progress)."""
+        out: dict = {}
+        try:
+            names = os.listdir(spill_dir)
+        except OSError:
+            return out
+        for name in sorted(names):
+            if not name.endswith(".meta"):
+                continue
+            try:
+                with open(os.path.join(spill_dir, name), "r",
+                          encoding="utf-8") as fh:
+                    meta = json.load(fh)
+                sess = cls(client_id=str(meta["client_id"]),
+                           update_id=str(meta["update_id"]),
+                           total=int(meta["total"]),
+                           spill_dir=spill_dir)
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+            out[(sess.client_id, sess.update_id)] = sess
+        return out

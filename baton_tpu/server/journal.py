@@ -39,15 +39,26 @@ Design points:
   :meth:`Journal.load` skips undecodable lines (warning, not error), so
   recovery always sees the longest valid prefix.
 
-Auth keys are journaled in the clear by necessity — they are what make
-"workers keep their credentials across a manager restart" possible.
-Treat the journal file like the TLS private key: same filesystem
-permissions, same operator.
+Auth keys are what make "workers keep their credentials across a
+manager restart" possible, so they must be journaled — but not in the
+clear: when ``BATON_JOURNAL_KEY`` is set (a passphrase, or a path to a
+file holding one) every ``key`` field is wrapped at the append/compact
+boundary (``enc1:`` envelope: HMAC-SHA256 keystream + truncated-HMAC
+tag, stdlib only) and unwrapped transparently on load. Legacy
+plaintext journals keep reading as-is — migration is "set the env var
+and let the next compaction rewrite the snapshot". A wrapped key that
+cannot be unwrapped (env var lost, or wrong) degrades to ``None``:
+the client re-registers instead of anyone trusting an unverifiable
+credential. Replication (:mod:`baton_tpu.server.replication`) ships
+journal bytes verbatim, so standbys see only wrapped keys on the wire
+and need the same ``BATON_JOURNAL_KEY`` to serve after promotion.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import hmac
 import json
 import logging
 import os
@@ -57,6 +68,81 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 _log = logging.getLogger(__name__)
 
 SNAPSHOT_SUFFIX = ".snapshot"
+
+#: env var naming the at-rest wrap key: either the passphrase itself or
+#: a path to a file whose (stripped) contents are the passphrase
+WRAP_KEY_ENV = "BATON_JOURNAL_KEY"
+_WRAP_PREFIX = "enc1:"
+
+
+def load_wrap_key(env: str = WRAP_KEY_ENV) -> Optional[bytes]:
+    """Resolve the at-rest wrap key from the environment; None (no
+    wrapping) when unset. A value that names a readable file is
+    dereferenced so the secret can live outside the process table."""
+    raw = os.environ.get(env)
+    if not raw:
+        return None
+    if os.path.isfile(raw):
+        try:
+            with open(raw, "r", encoding="utf-8") as fh:
+                raw = fh.read().strip()
+        except OSError as exc:
+            _log.warning("%s names an unreadable file (%s); at-rest key "
+                         "wrapping disabled", env, exc)
+            return None
+        if not raw:
+            return None
+    return hashlib.sha256(raw.encode("utf-8")).digest()
+
+
+def _keystream(wk: bytes, nonce: bytes, n: int) -> bytes:
+    out = bytearray()
+    counter = 0
+    while len(out) < n:
+        out.extend(hmac.new(wk, b"ks" + nonce + counter.to_bytes(4, "big"),
+                            hashlib.sha256).digest())
+        counter += 1
+    return bytes(out[:n])
+
+
+def wrap_value(plain: str, wk: bytes) -> str:
+    """``enc1:<nonce>:<ciphertext>:<tag>`` (hex fields) — encrypt-then-
+    MAC with independent HMAC-derived keystream and tag, stdlib only
+    (the serving image carries no cryptography package)."""
+    nonce = os.urandom(12)
+    pt = plain.encode("utf-8")
+    ct = bytes(a ^ b for a, b in zip(pt, _keystream(wk, nonce, len(pt))))
+    tag = hmac.new(wk, b"tag" + nonce + ct, hashlib.sha256).digest()[:16]
+    return _WRAP_PREFIX + nonce.hex() + ":" + ct.hex() + ":" + tag.hex()
+
+
+def unwrap_value(value: Any, wk: Optional[bytes]) -> Optional[str]:
+    """Inverse of :func:`wrap_value` with two deliberate degradations:
+    a non-``enc1:`` value passes through untouched (legacy plaintext
+    journals), and a wrapped value that cannot be verified — missing
+    key, wrong key, mangled envelope — becomes None so the client
+    re-registers rather than anyone trusting an unchecked credential."""
+    if not isinstance(value, str) or not value.startswith(_WRAP_PREFIX):
+        return value
+    if wk is None:
+        _log.warning("journal holds wrapped auth keys but %s is unset; "
+                     "dropping keys (clients will re-register)",
+                     WRAP_KEY_ENV)
+        return None
+    try:
+        nonce_hex, ct_hex, tag_hex = value[len(_WRAP_PREFIX):].split(":")
+        nonce = bytes.fromhex(nonce_hex)
+        ct = bytes.fromhex(ct_hex)
+        tag = bytes.fromhex(tag_hex)
+    except ValueError:
+        return None
+    want = hmac.new(wk, b"tag" + nonce + ct, hashlib.sha256).digest()[:16]
+    if not hmac.compare_digest(tag, want):
+        _log.warning("journaled auth key failed unwrap (wrong %s?); "
+                     "dropping key", WRAP_KEY_ENV)
+        return None
+    pt = bytes(a ^ b for a, b in zip(ct, _keystream(wk, nonce, len(ct))))
+    return pt.decode("utf-8", "replace")
 
 
 class Journal:
@@ -78,10 +164,17 @@ class Journal:
         self._fh = open(self.path, "a", encoding="utf-8")
         self._last_fsync = 0.0
         self.appends = 0
+        #: bumps on every compaction — the WAL shipper's frame id, since
+        #: compaction truncates the file and resets byte offsets
+        self.generation = 0
+        self._wrap_key = load_wrap_key()
 
     # ------------------------------------------------------------------
     def append(self, event: str, **fields: Any) -> None:
         """Durably record one control-plane event."""
+        if self._wrap_key is not None and isinstance(fields.get("key"), str):
+            fields = dict(fields, key=wrap_value(fields["key"],
+                                                 self._wrap_key))
         rec = {"event": event, **fields}
         self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._fh.flush()
@@ -131,6 +224,15 @@ class Journal:
                         events.append(rec)
         except OSError:
             pass
+        # transparent at-rest unwrap: plaintext legacy values pass
+        # through, unverifiable wrapped values degrade to None
+        if snapshot:
+            for c in (snapshot.get("clients") or {}).values():
+                if isinstance(c, dict) and "key" in c:
+                    c["key"] = unwrap_value(c["key"], self._wrap_key)
+        for rec in events:
+            if "key" in rec:
+                rec["key"] = unwrap_value(rec["key"], self._wrap_key)
         return snapshot, events
 
     def recover(self) -> "RecoveredState":
@@ -144,6 +246,14 @@ class Journal:
         Call only at a quiescent point (no round in flight): the
         snapshot schema carries membership and history, not an open
         round, so compacting mid-round would forget it."""
+        if self._wrap_key is not None and snapshot.get("clients"):
+            snapshot = dict(snapshot, clients={
+                cid: (dict(c, key=wrap_value(c["key"], self._wrap_key))
+                      if isinstance(c.get("key"), str)
+                      and not c["key"].startswith(_WRAP_PREFIX)
+                      else dict(c))
+                for cid, c in snapshot["clients"].items()
+            })
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
             json.dump(snapshot, fh, separators=(",", ":"))
@@ -156,6 +266,8 @@ class Journal:
         self._fh.flush()
         if self.fsync != "never":
             os.fsync(self._fh.fileno())
+        # byte offsets restart from zero — a new shipping generation
+        self.generation += 1
 
     def close(self) -> None:
         if not self._fh.closed:
@@ -180,8 +292,13 @@ class RecoveredState:
     n_rounds: int = 0
     loss_history: List[float] = dataclasses.field(default_factory=list)
     #: the in-flight round at crash time, or None:
-    #: {round_name, meta, participants: [ids], accepted: {cid: update_id}}
+    #: {round_name, meta, participants: [ids], accepted: {cid: update_id},
+    #:  payloads: {cid: {data: b64, content_type}}}
     open_round: Optional[dict] = None
+    #: highest leadership epoch ever journaled (``ha_lease`` events +
+    #: compaction snapshots) — 0 when replication was never enabled.
+    #: A promoting standby serves at ``ha_epoch + 1``.
+    ha_epoch: int = 0
     #: True when neither snapshot nor events existed — a fresh journal
     #: must not override e.g. a checkpoint-restored round counter.
     empty: bool = True
@@ -203,6 +320,7 @@ def replay(
         }
         st.n_rounds = int(snapshot.get("n_rounds", 0))
         st.loss_history = [float(x) for x in snapshot.get("loss_history", [])]
+        st.ha_epoch = int(snapshot.get("ha_epoch", 0))
     for ev in events:
         st.empty = False
         kind = ev.get("event")
@@ -218,12 +336,14 @@ def replay(
             if st.open_round is not None:
                 st.open_round["participants"].discard(cid)
                 st.open_round["accepted"].pop(cid, None)
+                st.open_round["payloads"].pop(cid, None)
         elif kind == "round_started":
             st.open_round = {
                 "round_name": ev.get("round_name"),
                 "meta": ev.get("meta") or {},
                 "participants": set(),
                 "accepted": {},
+                "payloads": {},
             }
         elif kind == "round_client_joined":
             if st.open_round is not None:
@@ -232,6 +352,20 @@ def replay(
             if st.open_round is not None:
                 st.open_round["participants"].discard(cid)
                 st.open_round["accepted"].pop(cid, None)
+                st.open_round["payloads"].pop(cid, None)
+        elif kind == "update_payload":
+            # the accepted upload's bytes, riding the WAL so a standby
+            # can finish the round without re-training the reporter
+            if (st.open_round is not None
+                    and ev.get("round_name") == st.open_round["round_name"]):
+                st.open_round["payloads"][cid] = {
+                    "data": ev.get("data"),
+                    "content_type": ev.get("content_type"),
+                }
+        elif kind == "ha_lease":
+            with_epoch = ev.get("epoch")
+            if isinstance(with_epoch, (int, float)):
+                st.ha_epoch = max(st.ha_epoch, int(with_epoch))
         elif kind == "update_accepted":
             if st.open_round is not None:
                 st.open_round["accepted"][cid] = ev.get("update_id")

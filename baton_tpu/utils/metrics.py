@@ -148,6 +148,31 @@ DECLARED_COUNTERS = frozenset({
     # retention (trace-spool GC + jsonl rotation PeriodicTasks)
     "trace_spool_gc_removed",
     "jsonl_rotations",
+    # replication control plane (server/replication.py + ha wiring)
+    "wal_segments_shipped",
+    "wal_bytes_shipped",
+    "wal_segments_applied",
+    "wal_segments_refused_stale",
+    "wal_resyncs",
+    "wal_snapshot_catchups",
+    "wal_snapshot_catchups_sent",
+    "wal_ship_errors",
+    "wal_ship_fenced",
+    "ha_promotions",
+    "ha_lease_renewals",
+    "heartbeats_redirected",
+    # manager: journaled-payload recovery (resume without re-training)
+    "recovery_updates_reused",
+    "recovery_payload_replays_failed",
+    "recovery_rebroadcasts",
+    "journal_payloads_journaled",
+    "journal_payloads_skipped_large",
+    "chunk_sessions_restored",
+    # worker: root-ring failover + topology redirects
+    "root_failovers",
+    "root_redirects_followed",
+    # loadgen: root-kill chaos phases
+    "scenario_roots_killed",
 })
 
 DECLARED_COUNTER_PREFIXES = (
@@ -234,6 +259,14 @@ DECLARED_GAUGES = frozenset({
     "compute_recompile_storm",
     "compute_steps",
     "compute_reporters",
+    # replication control plane (role, lease, WAL positions)
+    "replication_epoch",
+    "replication_role_active",
+    "replication_standbys",
+    "replication_wal_shipped_offset",
+    "replication_wal_applied_offset",
+    "replication_wal_lag_s",
+    "replication_lease_remaining_s",
 })
 
 

@@ -22,6 +22,17 @@ keep their previous numbers in the JSON):
   factor is the point), heartbeat p50/p95 through each route, root and
   edge ingest-fold percentiles, and verifies the edge-tier aggregate
   equals the flat fold within streaming-mean tolerance.
+* ``roots`` — control-plane sharding: 1 root vs N root replicas
+  carrying E experiments spread over the :class:`ExperimentTopology`
+  hash ring, at C>=1024 clients. Every client first contacts root-0 and
+  learns its experiment's owner through the live 307-redirect contract
+  (one redirect per misrouted client, never more), then the whole fleet
+  runs concurrent heartbeat waves against its learned root. Reports the
+  per-root registry occupancy and heartbeats served (count-exact — the
+  sharding claim), redirects followed vs the topology's prediction, and
+  heartbeat p50/p95 for both configurations. All roots share this one
+  process/event loop, so the latency columns show protocol cost only;
+  the load-division columns are the point.
 
 What runs: a manager with ``broadcast_delta`` on and C ``EchoWorker``s
 (no jit training — each "round" perturbs local params slightly so every
@@ -72,6 +83,7 @@ from aiohttp import web  # noqa: E402
 
 from baton_tpu.models.linear import linear_regression_model  # noqa: E402
 from baton_tpu.server import wire  # noqa: E402
+from baton_tpu.server import replication  # noqa: E402
 from baton_tpu.server.edge import EdgeAggregator  # noqa: E402
 from baton_tpu.server.http_manager import Manager  # noqa: E402
 from baton_tpu.server.http_worker import ExperimentWorker  # noqa: E402
@@ -711,9 +723,191 @@ async def _edge_section(c: int, dim: int, n_edges: int, rounds: int) -> dict:
     return out
 
 
+async def _roots_once(c: int, n_roots: int, n_exps: int, waves: int) -> dict:
+    """One root-replica configuration: ``n_exps`` experiments registered
+    on every one of ``n_roots`` roots (each root announcing itself via
+    ``ha_replica_id`` against the shared ``ha_replicas`` map), C clients
+    split round-robin over the experiments. Each client registers at
+    root-0, heartbeats once with redirects disabled, and — on a 307 —
+    re-registers at the owner the response names, exactly the lazy
+    topology-learning path a real worker takes. The heartbeat storm then
+    runs against the learned owners. The ghost registrations the
+    misrouted first contacts leave in root-0's registries are reported,
+    not hidden — in production the TTL monitor expires them."""
+    import aiohttp
+
+    ports = [_free_port() for _ in range(n_roots)]
+    urls = {f"root-{i}": f"http://127.0.0.1:{p}" for i, p in enumerate(ports)}
+    exp_names = [f"shard{j}" for j in range(n_exps)]
+
+    runners = []
+    roots = []  # rid -> list of experiments
+    for i, port in enumerate(ports):
+        mapp = web.Application()
+        mgr = Manager(mapp)
+        exps = []
+        for name in exp_names:
+            kwargs = {}
+            if n_roots > 1:
+                kwargs = {"ha_replicas": urls,
+                          "ha_replica_id": f"root-{i}"}
+            exps.append(mgr.register_experiment(
+                linear_regression_model(64, name=name), name=name,
+                start_background_tasks=False, **kwargs,
+            ))
+        mrunner = web.AppRunner(mapp)
+        await mrunner.setup()
+        await web.TCPSite(mrunner, "127.0.0.1", port).start()
+        runners.append(mrunner)
+        roots.append(exps)
+
+    # the same ring the managers built — predicts who owns what, and
+    # therefore exactly how many first contacts must be redirected
+    owner_of = {n: "root-0" for n in exp_names}
+    if n_roots > 1:
+        topo = replication.ExperimentTopology(sorted(urls))
+        owner_of = {n: topo.assign(n) for n in exp_names}
+    expected_redirects = sum(
+        1 for k in range(c) if owner_of[exp_names[k % n_exps]] != "root-0")
+
+    bench = Metrics()
+    lag_probe = LoopLagProbe(bench, interval=0.05)
+    lag_probe.start()
+    conn = aiohttp.TCPConnector(limit=256)
+    timeout = aiohttp.ClientTimeout(total=600.0)
+    session = aiohttp.ClientSession(connector=conn, timeout=timeout)
+
+    redirects = 0
+
+    async def enroll(k: int) -> tuple:
+        nonlocal redirects
+        name = exp_names[k % n_exps]
+        base = f"{urls['root-0']}/{name}"
+        async with session.get(f"{base}/register",
+                               json={"port": k + 1}) as r:
+            cred = await r.json()
+        async with session.get(
+            f"{base}/heartbeat", json={"client_id": cred["client_id"],
+                                       "key": cred["key"]},
+            allow_redirects=False,
+        ) as r:
+            if r.status == 307:
+                body = await r.json()
+                redirects += 1
+                base = body["url"].rstrip("/")
+                async with session.get(f"{base}/register",
+                                       json={"port": k + 1}) as r2:
+                    cred = await r2.json()
+            else:
+                assert r.status == 200, await r.text()
+        return name, base, cred
+
+    t0 = time.perf_counter()
+    clients = await asyncio.gather(*[enroll(k) for k in range(c)])
+    enroll_wall = time.perf_counter() - t0
+    assert redirects == expected_redirects, \
+        f"{redirects} redirects followed, topology predicted " \
+        f"{expected_redirects}"
+
+    async def beat(name: str, base: str, cred: dict):
+        with bench.timer("heartbeat_s"):
+            async with session.get(
+                f"{base}/heartbeat",
+                json={"client_id": cred["client_id"], "key": cred["key"]},
+                allow_redirects=False,
+            ) as r:
+                assert r.status == 200, f"{name}: {r.status}"
+
+    t0 = time.perf_counter()
+    for _ in range(waves):
+        await asyncio.gather(*[beat(*cl) for cl in clients])
+    storm_wall = time.perf_counter() - t0
+    lag_probe.stop()
+    await session.close()
+
+    served = {}
+    for name, base, _ in clients:
+        served[owner_of[name]] = served.get(owner_of[name], 0) + waves
+    per_root = []
+    for i in range(n_roots):
+        rid = f"root-{i}"
+        registered = sum(len(e.registry) for e in roots[i])
+        redirected = sum(
+            e.metrics.snapshot()["counters"].get("heartbeats_redirected", 0.0)
+            for e in roots[i])
+        per_root.append({
+            "replica": rid,
+            "experiments_owned":
+                sum(1 for n in exp_names if owner_of[n] == rid),
+            "clients": sum(1 for n, _, _ in clients if owner_of[n] == rid),
+            "registered_entries": registered,
+            "heartbeats_served": served.get(rid, 0),
+            "heartbeats_redirected": redirected,
+        })
+    for r in runners:
+        await r.cleanup()
+
+    hb = _timer_stats(bench, "heartbeat_s")
+    lag = _timer_stats(bench, "loop_lag_s")
+    return {
+        "n_roots": n_roots,
+        "cohort": c,
+        "experiments": n_exps,
+        "enroll_wall_s": enroll_wall,
+        "redirects_followed": redirects,
+        "storm_waves": waves,
+        "heartbeats_total": c * waves,
+        "storm_wall_s": storm_wall,
+        "heartbeats_per_s": c * waves / storm_wall,
+        "heartbeat_p50_s": hb["p50_s"],
+        "heartbeat_p95_s": hb["p95_s"],
+        "max_root_clients": max(p["clients"] for p in per_root),
+        "ghost_registrations_at_root0": redirects,
+        "loop_lag_p95_s": lag["p95_s"],
+        "loop_lag_max_s": lag["max_s"],
+        "per_root": per_root,
+    }
+
+
+async def _roots_section(c: int, n_roots: int, n_exps: int,
+                         waves: int) -> dict:
+    """1 root vs ``n_roots`` replicas at the same C. The division of
+    per-root load (registry occupancy, heartbeats served) is the claim;
+    latency columns carry the shared-event-loop caveat."""
+    print(f"[roots] C={c}, {n_exps} experiments, 1 root (flat)...",
+          file=sys.stderr, flush=True)
+    flat = await _roots_once(c, 1, n_exps, waves)
+    print(f"[roots] C={c}, {n_roots} root replicas (hash-ring sharded)...",
+          file=sys.stderr, flush=True)
+    sharded = await _roots_once(c, n_roots, n_exps, waves)
+
+    for p in sharded["per_root"]:
+        assert p["experiments_owned"] >= 1, \
+            f"{p['replica']} owns no experiments — ring imbalanced"
+    reduction = flat["max_root_clients"] / max(sharded["max_root_clients"], 1)
+    assert reduction >= 2.0, \
+        f"per-root load reduction {reduction:.1f}x < 2x with " \
+        f"{n_roots} roots"
+    out = {
+        "cohort": c,
+        "n_roots": n_roots,
+        "experiments": n_exps,
+        "flat": flat,
+        "sharded": sharded,
+        "per_root_load_reduction_x": reduction,
+    }
+    print(f"[roots] busiest root: {flat['max_root_clients']} -> "
+          f"{sharded['max_root_clients']} clients ({reduction:.1f}x), "
+          f"{sharded['redirects_followed']} one-time redirects, "
+          f"storm {sharded['heartbeats_per_s']:.0f} hb/s",
+          file=sys.stderr, flush=True)
+    return out
+
+
 async def _main(cohorts, dim, rounds, spec, sections, uplink_cohort,
                 uplink_dim, resume_mb, chunk_mb, edge_cohort, edge_count,
-                edge_rounds, prior) -> dict:
+                edge_rounds, roots_cohort, roots_count, roots_exps,
+                roots_waves, prior) -> dict:
     out = {
         "benchmark": "dataplane_scale",
         "delta_spec": spec,
@@ -726,6 +920,7 @@ async def _main(cohorts, dim, rounds, spec, sections, uplink_cohort,
         "uplink": prior.get("uplink"),
         "chunk_resume": prior.get("chunk_resume"),
         "edge_topology": prior.get("edge_topology"),
+        "root_sharding": prior.get("root_sharding"),
     }
     if "downlink" in sections:
         out["results"] = []
@@ -738,6 +933,9 @@ async def _main(cohorts, dim, rounds, spec, sections, uplink_cohort,
     if "edge" in sections:
         out["edge_topology"] = await _edge_section(
             edge_cohort, dim, edge_count, edge_rounds)
+    if "roots" in sections:
+        out["root_sharding"] = await _roots_section(
+            roots_cohort, roots_count, roots_exps, roots_waves)
     return out
 
 
@@ -748,7 +946,7 @@ if __name__ == "__main__":
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--delta-spec", default="topk:0.05:q8")
     ap.add_argument("--sections", default="downlink,uplink,resume",
-                    help="comma list of downlink,uplink,resume,edge; "
+                    help="comma list of downlink,uplink,resume,edge,roots; "
                          "skipped sections keep the previous JSON's "
                          "numbers")
     ap.add_argument("--uplink-cohort", type=int, default=64)
@@ -759,6 +957,10 @@ if __name__ == "__main__":
     ap.add_argument("--edge-cohort", type=int, default=256)
     ap.add_argument("--edge-count", type=int, default=4)
     ap.add_argument("--edge-rounds", type=int, default=2)
+    ap.add_argument("--roots-cohort", type=int, default=1024)
+    ap.add_argument("--roots-count", type=int, default=4)
+    ap.add_argument("--roots-experiments", type=int, default=16)
+    ap.add_argument("--roots-waves", type=int, default=3)
     ap.add_argument(
         "--out",
         default=os.path.join(os.path.dirname(__file__),
@@ -777,7 +979,9 @@ if __name__ == "__main__":
     result = asyncio.run(_main(
         cohorts, args.dim, args.rounds, args.delta_spec, sections,
         args.uplink_cohort, args.uplink_dim, args.resume_mb, args.chunk_mb,
-        args.edge_cohort, args.edge_count, args.edge_rounds, prior,
+        args.edge_cohort, args.edge_count, args.edge_rounds,
+        args.roots_cohort, args.roots_count, args.roots_experiments,
+        args.roots_waves, prior,
     ))
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
@@ -806,4 +1010,12 @@ if __name__ == "__main__":
               f"per round ({et['root_downlink_reduction_x']:.1f}x, "
               f"{et['n_edges']} edges), aggregate max "
               f"|d|={et['aggregate_max_abs_diff']:.2e}")
+    if result.get("root_sharding"):
+        rs = result["root_sharding"]
+        print(f"roots C={rs['cohort']}: busiest root "
+              f"{rs['flat']['max_root_clients']} -> "
+              f"{rs['sharded']['max_root_clients']} clients "
+              f"({rs['per_root_load_reduction_x']:.1f}x across "
+              f"{rs['n_roots']} roots, "
+              f"{rs['sharded']['redirects_followed']} one-time 307s)")
     print(f"wrote {args.out}")

@@ -1,0 +1,719 @@
+"""Control-plane replication tests (ISSUE 14 acceptance):
+
+* WAL shipping: (generation, offset) framing, gap resync, snapshot
+  catch-up after compaction, and the stale-epoch fence (409) in both
+  the pure state machine and the HTTP route;
+* durability gaps closed: journaled update payloads make a resumed
+  round reuse every delivered update (zero re-training), and chunked
+  upload sessions spilled to disk survive a manager restart;
+* lease/epoch failover end to end on real sockets: the active root is
+  killed mid-round, the warm standby replays the shipped WAL, bumps
+  the epoch, finishes the round, and fences the dead epoch's writes;
+* satellites: at-rest key wrapping via ``BATON_JOURNAL_KEY``, the
+  secure-agg abort-on-failover policy's observability record, and the
+  experiment-topology 307 redirect contract.
+"""
+
+import asyncio
+import json
+import os
+import tempfile
+
+import numpy as np
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from baton_tpu.core.training import make_local_trainer
+from baton_tpu.data.synthetic import linear_client_data
+from baton_tpu.models.linear import linear_regression_model
+from baton_tpu.server import replication, wire
+from baton_tpu.server.http_manager import Manager
+from baton_tpu.server.http_worker import ExperimentWorker
+from baton_tpu.server.ingest import ChunkSession
+from baton_tpu.server.journal import (
+    WRAP_KEY_ENV,
+    Journal,
+    unwrap_value,
+    wrap_value,
+)
+from baton_tpu.server.state import params_to_state_dict
+from baton_tpu.utils.faults import FaultInjector
+
+from test_http_protocol import free_port
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _wait(cond, n=600, dt=0.05):
+    for _ in range(n):
+        if cond():
+            return True
+        await asyncio.sleep(dt)
+    return cond()
+
+
+def _wire_pair(name, journal, receiver, replica_id="root-a"):
+    """Shipper whose POSTs are short-circuited straight into
+    ``receiver.apply`` — the framing state machine without sockets."""
+    shipper = replication.WalShipper(
+        name, journal, ["http://standby"], replica_id, lambda: None
+    )
+
+    async def fake_post(url, t, seg):
+        status, body = receiver.apply(seg)
+        shipper._on_response(url, t, seg, status, body)
+
+    shipper._post = fake_post
+    return shipper
+
+
+# ----------------------------------------------------------------------
+# WAL framing: ship, resync, snapshot catch-up, stale-epoch fence
+
+
+def test_wal_ship_tail_and_replay_roundtrip():
+    """Incremental shipping reproduces the active's journal byte-for-
+    byte on the standby, and the standby's replay sees the same state."""
+
+    async def main():
+        with tempfile.TemporaryDirectory() as td:
+            src = Journal(os.path.join(td, "a.jsonl"), fsync="never")
+            recv = replication.WalReceiver(os.path.join(td, "b.jsonl"))
+            shipper = _wire_pair("exp", src, recv)
+
+            src.append("client_registered", client_id="c1", key="k1",
+                       remote="127.0.0.1", port=1, url="http://x/")
+            # first ship is a full segment (receiver starts at gen None)
+            await shipper.ship_once(1, replication.make_lease(1, "a", 3.0))
+            assert recv.generation == src.generation
+            assert recv.offset == os.path.getsize(src.path)
+
+            src.append("round_started", round_name="r1", meta={})
+            src.append("round_client_joined", round_name="r1",
+                       client_id="c1")
+            await shipper.ship_once(1)
+            assert recv.offset == os.path.getsize(src.path)
+            with open(src.path, "rb") as fa, open(recv.path, "rb") as fb:
+                assert fa.read() == fb.read()
+
+            st = Journal(recv.path, fsync="never").recover()
+            assert set(st.clients) == {"c1"}
+            assert st.clients["c1"]["key"] == "k1"
+            assert st.open_round["round_name"] == "r1"
+            assert st.open_round["participants"] == {"c1"}
+
+            # a caught-up standby still gets the lease heartbeat
+            lease = replication.make_lease(1, "a", 3.0)
+            await shipper.ship_once(1, lease)
+            assert recv.lease == lease
+            src.close()
+
+    run(main())
+
+
+def test_wal_gap_resync_and_snapshot_catchup():
+    """A receiver that lost bytes answers 409 resync; a compaction
+    (generation bump) forces the full snapshot+journal segment."""
+
+    async def main():
+        with tempfile.TemporaryDirectory() as td:
+            src = Journal(os.path.join(td, "a.jsonl"), fsync="never")
+            recv = replication.WalReceiver(os.path.join(td, "b.jsonl"))
+            shipper = _wire_pair("exp", src, recv)
+
+            src.append("client_registered", client_id="c1", key="k1")
+            await shipper.ship_once(1)
+            assert recv.offset == os.path.getsize(src.path)
+
+            # simulate a standby restart: its in-memory cursor is gone
+            recv2 = replication.WalReceiver(recv.path)
+            seg = shipper._tail_segment(1, recv.offset, None)
+            status, body = recv2.apply(seg)
+            assert status == 409 and body["error"] == "resync"
+            assert body["need_full"]  # fresh receiver knows no generation
+
+            # compaction truncates the file and bumps the generation:
+            # the next ship_once must fall back to a full segment
+            src.append("round_ended", round_name="r0", n_rounds=1)
+            src.compact({"clients": {"c1": {"key": "k1"}}, "n_rounds": 1,
+                         "loss_history": [], "ha_epoch": 1})
+            src.append("client_registered", client_id="c2", key="k2")
+            await shipper.ship_once(1)
+            assert recv.generation == src.generation
+            assert recv.offset == os.path.getsize(src.path)
+            assert os.path.exists(recv.snapshot_path)
+
+            st = Journal(recv.path, fsync="never").recover()
+            assert st.n_rounds == 1 and set(st.clients) == {"c1", "c2"}
+            assert st.ha_epoch == 1
+            src.close()
+
+    run(main())
+
+
+def test_wal_stale_epoch_fences_zombie_shipper():
+    """A receiver that has seen epoch N refuses epoch N-1 segments with
+    409 stale_epoch, and the shipper permanently fences that target."""
+
+    async def main():
+        with tempfile.TemporaryDirectory() as td:
+            src = Journal(os.path.join(td, "a.jsonl"), fsync="never")
+            recv = replication.WalReceiver(os.path.join(td, "b.jsonl"))
+            shipper = _wire_pair("exp", src, recv)
+
+            src.append("client_registered", client_id="c1", key="k1")
+            await shipper.ship_once(2, replication.make_lease(2, "a", 3.0))
+            assert recv.epoch == 2
+
+            status, body = recv.apply(shipper._tail_segment(1, recv.offset,
+                                                            None))
+            assert status == 409 and body["error"] == "stale_epoch"
+            assert body["epoch"] == 2
+
+            # promotion closes the receiver outright: even the current
+            # epoch is refused once the standby serves
+            recv.closed = True
+            status, body = recv.apply(shipper._tail_segment(2, recv.offset,
+                                                            None))
+            assert status == 409 and body["error"] == "stale_epoch"
+
+            # the shipper side of the fence
+            await shipper.ship_once(1)
+            assert shipper.fenced
+            await shipper.ship_once(9)  # fenced targets are never retried
+            assert shipper.positions()["http://standby"]["fenced"]
+            src.close()
+
+    run(main())
+
+
+def test_lease_expiry_semantics():
+    recv = replication.WalReceiver.__new__(replication.WalReceiver)
+    recv.lease = None
+    # a standby that never heard a lease must NOT promote (cold boot)
+    assert not recv.lease_expired(0.0)
+    recv.lease = replication.make_lease(1, "a", 1.0, now=100.0)
+    assert not recv.lease_expired(0.5, now=101.2)
+    assert recv.lease_expired(0.5, now=101.6)
+
+
+def test_experiment_topology_minimal_reassignment():
+    reps = [f"root-{i}" for i in range(4)]
+    topo = replication.ExperimentTopology(reps)
+    names = [f"exp{i}" for i in range(64)]
+    before = {n: topo.assign(n) for n in names}
+    assert None not in before.values()
+    assert len(set(before.values())) > 1  # 64 names spread the ring
+    victim = before["exp0"]
+    topo.mark_dead(victim)
+    after = {n: topo.assign(n) for n in names}
+    # only the dead replica's experiments moved, and none to the dead
+    for n in names:
+        if before[n] != victim:
+            assert after[n] == before[n]
+        else:
+            assert after[n] != victim and after[n] is not None
+    topo.mark_alive(victim)
+    assert {n: topo.assign(n) for n in names} == before
+    # all dead => unassignable, not a crash
+    for r in reps:
+        topo.mark_dead(r)
+    assert topo.assign("exp0") is None
+
+
+# ----------------------------------------------------------------------
+# HTTP plumbing: wal_segment route, standby 503, heartbeat 307
+
+
+def test_wal_segment_route_auth_and_fence():
+    """The wal_segment ingress: 401 without the shared token, 200 into
+    a standby's receiver, 409 stale_epoch from an active replica."""
+
+    async def main():
+        with tempfile.TemporaryDirectory() as td:
+            app = web.Application()
+            exp = Manager(app).register_experiment(
+                linear_regression_model(4), name="ha",
+                journal_path=os.path.join(td, "sb.jsonl"),
+                ha_role="standby", ha_token="s3cret",
+                start_background_tasks=False,
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+
+            seg = {"epoch": 1, "replica": "root-a", "generation": 0,
+                   "offset": 0, "data": "", "full": True, "snapshot": None,
+                   "lease": replication.make_lease(1, "root-a", 3.0)}
+            resp = await client.post("/ha/wal_segment", json=seg)
+            assert resp.status == 401
+            hdr = {replication.HA_TOKEN_HEADER: "s3cret"}
+            resp = await client.post("/ha/wal_segment", json=seg,
+                                     headers=hdr)
+            assert resp.status == 200
+            body = await resp.json()
+            assert body == {"generation": 0, "offset": 0}
+            assert exp._wal_receiver.epoch == 1
+
+            # a standby refuses every serving route while not promoted
+            resp = await client.get("/ha/register", json={"port": 1})
+            assert resp.status == 503
+            assert (await resp.json())["error"] == "Standby"
+            await client.close()
+
+            # an ACTIVE replica fences any segment at or below its epoch
+            app2 = web.Application()
+            exp2 = Manager(app2).register_experiment(
+                linear_regression_model(4), name="ha",
+                journal_path=os.path.join(td, "act.jsonl"),
+                ha_role="active", start_background_tasks=False,
+            )
+            assert exp2.ha_epoch == 1
+            client2 = TestClient(TestServer(app2))
+            await client2.start_server()
+            resp = await client2.post("/ha/wal_segment", json=seg)
+            assert resp.status == 409
+            assert (await resp.json())["error"] == "stale_epoch"
+            snap = exp2.metrics.snapshot()["counters"]
+            assert snap["wal_segments_refused_stale"] == 1
+            resp = await client2.post(
+                "/ha/wal_segment", json=dict(seg, epoch=9))
+            assert resp.status == 409
+            assert (await resp.json())["error"] == "not_standby"
+
+            resp = await client2.get("/ha/replication")
+            rep = await resp.json()
+            assert rep["role"] == "active" and rep["epoch"] == 1
+            assert rep["lease"]["holder"] == "ha"
+            await client2.close()
+
+    run(main())
+
+
+def test_heartbeat_redirects_to_topology_owner():
+    """A heartbeat landing on a replica that doesn't own the experiment
+    answers 307 with the owner's URL and the full topology map."""
+
+    async def main():
+        replicas = {"root-a": "http://a.invalid", "root-b": "http://b.invalid"}
+        owner = replication.ExperimentTopology(sorted(replicas)).assign("top")
+        loser = next(r for r in replicas if r != owner)
+
+        app = web.Application()
+        exp = Manager(app).register_experiment(
+            linear_regression_model(4), name="top",
+            ha_replicas=replicas, ha_replica_id=loser,
+            start_background_tasks=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        resp = await client.get("/top/register", json={"port": 1})
+        cred = await resp.json()
+        resp = await client.get(
+            "/top/heartbeat",
+            json={"client_id": cred["client_id"], "key": cred["key"]},
+            allow_redirects=False,
+        )
+        assert resp.status == 307
+        body = await resp.json()
+        assert body["replica"] == owner
+        assert body["url"] == f"{replicas[owner]}/top/"
+        assert body["topology"] == replicas
+        assert resp.headers["Location"] == f"{replicas[owner]}/top/heartbeat"
+        snap = exp.metrics.snapshot()["counters"]
+        assert snap["heartbeats_redirected"] == 1
+        await client.close()
+
+    run(main())
+
+
+# ----------------------------------------------------------------------
+# durability gap 1: journaled update payloads => zero re-training
+
+
+def test_resumed_round_reuses_journaled_payloads():
+    """Crash AFTER two of three participants delivered: the rebuilt
+    manager re-ingests their journaled payload bytes — both reused,
+    neither re-trained, and the round completes without them."""
+
+    async def main():
+        with tempfile.TemporaryDirectory() as td:
+            jp = os.path.join(td, "wal.jsonl")
+            app = web.Application()
+            exp = Manager(app).register_experiment(
+                linear_regression_model(4), name="pay",
+                journal_path=jp, journal_fsync="never",
+                recovery_policy="resume", start_background_tasks=False,
+            )
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            creds = []
+            for port in (1, 2, 3):
+                resp = await client.get("/pay/register", json={"port": port})
+                creds.append(await resp.json())
+            exp.rounds.start_round(n_epoch=1)
+            for c in creds:
+                exp.rounds.client_start(c["client_id"])
+            round_name = exp.rounds.round_name
+            for i, c in enumerate(creds[:2]):
+                body = wire.encode(
+                    params_to_state_dict(exp.params),
+                    {"update_name": round_name, "n_samples": 4 + i,
+                     "loss_history": [0.1], "update_id": f"uid-{i}"},
+                )
+                resp = await client.post(
+                    f"/pay/update?client_id={c['client_id']}"
+                    f"&key={c['key']}",
+                    data=body,
+                    headers={"Content-Type": wire.CONTENT_TYPE},
+                )
+                assert resp.status == 200
+            assert exp.rounds.in_progress and exp.rounds.clients_left == 1
+            snap = exp.metrics.snapshot()["counters"]
+            assert snap["journal_payloads_journaled"] == 2
+            exp.journal.close()
+            await client.close()  # the crash
+
+            app2 = web.Application()
+            exp2 = Manager(app2).register_experiment(
+                linear_regression_model(4), name="pay",
+                journal_path=jp, journal_fsync="never",
+                recovery_policy="resume", start_background_tasks=False,
+            )
+            assert exp2._recovered_round is not None
+            assert set(exp2._recovered_round["payloads"]) == {
+                c["client_id"] for c in creds[:2]
+            }
+            captured = {}
+            orig_end = exp2.rounds.end_round
+
+            def end_wrapper():
+                responses = orig_end()
+                captured.update(responses)
+                return responses
+
+            exp2.rounds.end_round = end_wrapper
+            await exp2._resume_round()
+            # the third participant's re-announce fails (nothing listens
+            # on its callback port), so the round finishes on exactly
+            # the two replayed payloads — with their ORIGINAL bytes
+            assert await _wait(lambda: exp2.rounds.n_rounds == 1)
+            assert set(captured) == {c["client_id"] for c in creds[:2]}
+            assert sorted(r["n_samples"] for r in captured.values()) == [4, 5]
+            snap = exp2.metrics.snapshot()["counters"]
+            assert snap["recovery_updates_reused"] == 2
+            assert snap["recovery_rounds_resumed"] == 1
+            assert snap.get("recovery_payload_replays_failed", 0) == 0
+            assert snap["recovery_rebroadcasts"] == 1
+            if exp2.journal is not None:
+                exp2.journal.close()
+            session = exp2._session
+            await session.close()
+
+    run(main())
+
+
+# ----------------------------------------------------------------------
+# durability gap 2: chunk-upload sessions spill to disk
+
+
+def test_chunk_session_spill_survives_restart():
+    with tempfile.TemporaryDirectory() as td:
+        sess = ChunkSession(client_id="c1", update_id="u1", total=10,
+                            spill_dir=td)
+        sess.extend(b"hello")
+        assert sess.offset == 5
+
+        restored = ChunkSession.restore_sessions(td)  # the restart
+        assert set(restored) == {("c1", "u1")}
+        back = restored[("c1", "u1")]
+        assert back.offset == 5 and back.total == 10
+        back.extend(b"world")
+        assert back.payload() == b"helloworld"
+        back.discard()
+        assert ChunkSession.restore_sessions(td) == {}
+        assert os.listdir(td) == []
+
+
+def test_manager_restores_spilled_chunk_sessions():
+    async def main():
+        with tempfile.TemporaryDirectory() as td:
+            sess = ChunkSession(client_id="c9", update_id="u9", total=8,
+                                spill_dir=td)
+            sess.extend(b"abc")
+            app = web.Application()
+            exp = Manager(app).register_experiment(
+                linear_regression_model(4), name="sp",
+                chunk_spill_dir=td, start_background_tasks=False,
+            )
+            assert set(exp._chunks) == {("c9", "u9")}
+            assert exp._chunks[("c9", "u9")].offset == 3
+            snap = exp.metrics.snapshot()["counters"]
+            assert snap["chunk_sessions_restored"] == 1
+
+    run(main())
+
+
+# ----------------------------------------------------------------------
+# satellite: at-rest key wrapping via BATON_JOURNAL_KEY
+
+
+def test_journal_key_wrapping_at_rest(monkeypatch):
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "wal.jsonl")
+        monkeypatch.setenv(WRAP_KEY_ENV, "hunter2")
+        j = Journal(path, fsync="never")
+        j.append("client_registered", client_id="c1", key="topsecret",
+                 port=1)
+        j.compact({"clients": {"c2": {"key": "alsosecret"}},
+                   "n_rounds": 0, "loss_history": []})
+        j.append("client_registered", client_id="c3", key="third", port=3)
+        j.close()
+        on_disk = open(path).read() + open(path + ".snapshot").read()
+        assert "topsecret" not in on_disk
+        assert "alsosecret" not in on_disk
+        assert "third" not in on_disk
+        assert "enc1:" in on_disk
+
+        # same key: transparent unwrap on load
+        st = Journal(path, fsync="never").recover()
+        assert st.clients["c2"]["key"] == "alsosecret"
+        assert st.clients["c3"]["key"] == "third"
+
+        # wrong key: degrade to None (client re-registers), never junk
+        monkeypatch.setenv(WRAP_KEY_ENV, "wrong")
+        st = Journal(path, fsync="never").recover()
+        assert st.clients["c2"]["key"] is None
+        assert st.clients["c3"]["key"] is None
+
+        # legacy plaintext journals keep reading with the key set
+        monkeypatch.delenv(WRAP_KEY_ENV)
+        legacy = os.path.join(td, "legacy.jsonl")
+        jl = Journal(legacy, fsync="never")
+        jl.append("client_registered", client_id="c1", key="plain", port=1)
+        jl.close()
+        monkeypatch.setenv(WRAP_KEY_ENV, "hunter2")
+        st = Journal(legacy, fsync="never").recover()
+        assert st.clients["c1"]["key"] == "plain"
+
+
+def test_wrap_value_roundtrip_and_tamper():
+    import hashlib
+
+    wk = hashlib.sha256(b"passphrase").digest()
+    wrapped = wrap_value("the-key", wk)
+    assert wrapped.startswith("enc1:") and "the-key" not in wrapped
+    assert unwrap_value(wrapped, wk) == "the-key"
+    assert unwrap_value(wrapped, None) is None
+    tampered = wrapped[:-2] + ("00" if wrapped[-2:] != "00" else "11")
+    assert unwrap_value(tampered, wk) is None
+    assert unwrap_value("plaintext", wk) == "plaintext"
+
+
+# ----------------------------------------------------------------------
+# satellite: secure-agg rounds abort (observably) on failover
+
+
+def test_secure_round_abort_on_recovery_is_observable(monkeypatch):
+    """recovery_policy aside, a secure round can never resume (mask
+    state died with the process); the abort must land in rounds.jsonl
+    AND alerts.jsonl, not just a log line."""
+
+    async def main():
+        with tempfile.TemporaryDirectory() as td:
+            jp = os.path.join(td, "wal.jsonl")
+            rounds_log = os.path.join(td, "rounds.jsonl")
+            alerts_log = os.path.join(td, "alerts.jsonl")
+            j = Journal(jp, fsync="never")
+            j.append("client_registered", client_id="c1", key="k1", port=1,
+                     url="http://127.0.0.1:1/", remote="127.0.0.1")
+            j.append("round_started", round_name="sec_round",
+                     meta={"n_epoch": 1})
+            j.append("round_client_joined", round_name="sec_round",
+                     client_id="c1")
+            j.close()
+
+            app = web.Application()
+            exp = Manager(app).register_experiment(
+                linear_regression_model(4), name="sec",
+                journal_path=jp, journal_fsync="never", secure_agg=True,
+                recovery_policy="resume",
+                rounds_log_path=rounds_log, alerts_log_path=alerts_log,
+                start_background_tasks=False,
+            )
+            assert exp._recovered_round is None  # staged nothing
+            snap = exp.metrics.snapshot()["counters"]
+            assert snap["recovery_rounds_aborted"] == 1
+
+            recs = [json.loads(x) for x in open(rounds_log)]
+            assert any(
+                r.get("round") == "sec_round"
+                and r.get("outcome") == "aborted:recovery_secure_agg"
+                for r in recs
+            )
+            evs = [json.loads(x) for x in open(alerts_log)]
+            assert any(
+                e.get("event") == "recovery_round_aborted"
+                and e.get("round") == "sec_round"
+                and e.get("reason") == "secure_agg"
+                for e in evs
+            )
+            exp.journal.close()
+
+    run(main())
+
+
+# ----------------------------------------------------------------------
+# the chaos target: real-socket mid-round failover
+
+
+async def _start_ha_manager(name, port, inj=None, **exp_kwargs):
+    model = linear_regression_model(10)
+    middlewares = [inj.middleware] if inj is not None else []
+    mapp = web.Application(middlewares=middlewares)
+    exp = Manager(mapp).register_experiment(model, name=name, **exp_kwargs)
+    mrunner = web.AppRunner(mapp)
+    await mrunner.setup()
+    await web.TCPSite(mrunner, "127.0.0.1", port).start()
+    return exp, mrunner
+
+
+def test_mid_round_failover_to_warm_standby():
+    """Kill the active root mid-round: the standby observes lease
+    expiry, replays the shipped WAL, bumps the epoch, resumes the round
+    under its original name, and the workers' parked updates finish it.
+    The dead epoch's WAL writes are refused 409."""
+
+    async def main():
+        import aiohttp
+
+        name = "failover"
+        with tempfile.TemporaryDirectory() as td:
+            mport, sbport = free_port(), free_port()
+            inj = FaultInjector()
+            exp_a, mrunner_a = await _start_ha_manager(
+                name, mport, inj=inj,
+                journal_path=os.path.join(td, "active.jsonl"),
+                journal_fsync="never", recovery_policy="resume",
+                ha_role="active", ha_replica_id="root-a",
+                ha_standbys=[f"http://127.0.0.1:{sbport}"],
+                ha_lease_s=0.6, ha_ship_interval_s=0.1,
+            )
+            exp_b, mrunner_b = await _start_ha_manager(
+                name, sbport,
+                journal_path=os.path.join(td, "standby.jsonl"),
+                journal_fsync="never", recovery_policy="resume",
+                ha_role="standby", ha_replica_id="root-b",
+                ha_lease_s=0.6, ha_ship_interval_s=0.1,
+                ha_promote_grace_s=0.3,
+            )
+            assert exp_a.ha_epoch == 1 and exp_b.ha_epoch == 0
+
+            trainer = make_local_trainer(linear_regression_model(10),
+                                         batch_size=32, learning_rate=0.02)
+            model = linear_regression_model(10)
+            nprng = np.random.default_rng(7)
+            workers, wrunners = [], []
+            for _ in range(2):
+                wport = free_port()
+                data = linear_client_data(nprng, min_batches=2,
+                                          max_batches=2)
+                wapp = web.Application()
+                w = ExperimentWorker(
+                    wapp, model, f"127.0.0.1:{mport}",
+                    name=name, port=wport, heartbeat_time=0.3,
+                    trainer=trainer,
+                    get_data=lambda d=data: (d, d["x"].shape[0]),
+                    outbox_backoff=(0.05, 0.4),
+                    failover=[f"127.0.0.1:{sbport}"],
+                )
+                wrunner = web.AppRunner(wapp)
+                await wrunner.setup()
+                await web.TCPSite(wrunner, "127.0.0.1", wport).start()
+                workers.append(w)
+                runners = wrunners
+                runners.append(wrunner)
+            assert await _wait(lambda: len(exp_a.registry) == 2)
+
+            # warm-up round: compiles the trainer AND compacts the
+            # journal (generation bump => the shipper's full-segment
+            # path is exercised on a live fleet)
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{mport}/{name}/start_round?n_epoch=2"
+                ) as resp:
+                    assert resp.status == 200
+            assert await _wait(lambda: not exp_a.rounds.in_progress)
+            assert exp_a.rounds.n_rounds == 1
+
+            # round 2: every update refused, so the round is open and
+            # both workers have parked updates when the active dies
+            inj.error(f"/{name}/update", status=503)
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{mport}/{name}/start_round?n_epoch=2"
+                ) as resp:
+                    assert resp.status == 200
+            crashed_round = exp_a.rounds.round_name
+            assert await _wait(
+                lambda: all(not w.round_in_progress for w in workers)
+                and all(w._pending is not None for w in workers)
+            )
+            # the standby must hold the full WAL prefix (same
+            # generation, at least through the parked round's events)
+            need = os.path.getsize(exp_a.journal.path)
+            gen = exp_a.journal.generation
+            assert await _wait(
+                lambda: exp_b._wal_receiver.generation == gen
+                and exp_b._wal_receiver.offset >= need
+            )
+            assert exp_b._wal_receiver.lease is not None
+            old_epoch = exp_a.ha_epoch
+
+            await mrunner_a.cleanup()  # kill the active root
+
+            # lease lapses -> standby promotes itself and resumes the
+            # round; the workers' outboxes fail over to it and deliver
+            assert await _wait(lambda: exp_b.ha_role == "active", n=900)
+            assert exp_b.ha_epoch > old_epoch
+            snap = exp_b.metrics.snapshot()["counters"]
+            assert snap["ha_promotions"] == 1
+            assert snap["recovery_rounds_resumed"] == 1
+            assert await _wait(lambda: exp_b.rounds.n_rounds == 2, n=900)
+            assert not exp_b.rounds.in_progress
+            assert exp_b.rounds.round_name == crashed_round
+            assert any(
+                w.metrics.snapshot()["counters"].get("root_failovers", 0)
+                >= 1
+                for w in workers
+            )
+
+            # the dead epoch's WAL stream is fenced with 409
+            seg = {"epoch": old_epoch, "replica": "root-a",
+                   "generation": gen, "offset": need, "data": "",
+                   "full": False, "snapshot": None,
+                   "lease": replication.make_lease(old_epoch, "root-a",
+                                                   0.6)}
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{sbport}/{name}/wal_segment",
+                    json=seg,
+                ) as resp:
+                    assert resp.status == 409
+                    body = await resp.json()
+                    assert body["error"] == "stale_epoch"
+
+            # the promoted root serves: one more clean round
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{sbport}/{name}/start_round"
+                    "?n_epoch=2"
+                ) as resp:
+                    assert resp.status == 200
+            assert await _wait(lambda: exp_b.rounds.n_rounds == 3, n=900)
+
+            for r in [mrunner_b] + wrunners:
+                await r.cleanup()
+
+    run(main())
