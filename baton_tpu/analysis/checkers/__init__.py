@@ -15,6 +15,7 @@ from baton_tpu.analysis.checkers import (  # noqa: F401
     donation,
     exemplars,
     locks,
+    runbooks,
     spans,
     staleness,
     tracer,

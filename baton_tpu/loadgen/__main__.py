@@ -45,6 +45,7 @@ def main(argv=None) -> int:
     from baton_tpu.loadgen.scenario import ScenarioError, load_scenario
     from baton_tpu.loadgen.slo import evaluate_slo, write_report
     from baton_tpu.obs.alerts import read_alerts_jsonl
+    from baton_tpu.obs.runbooks import read_runbooks_jsonl
     from baton_tpu.utils.slog import read_rounds_jsonl, setup_json_logging
 
     setup_json_logging(level=logging.INFO)
@@ -85,6 +86,19 @@ def main(argv=None) -> int:
     alerts_path = os.path.join(artifacts, "alerts.jsonl")
     alert_events = (read_alerts_jsonl(alerts_path)[0]
                     if os.path.exists(alerts_path) else [])
+    # the actuation lifecycle stream backs ``runbook:*`` the same way;
+    # runbooks disabled → no file → [] (runbook: addresses resolve to 0)
+    runbooks_path = os.path.join(artifacts, "runbooks.jsonl")
+    runbook_events = (read_runbooks_jsonl(runbooks_path)[0]
+                      if os.path.exists(runbooks_path) else [])
+    # per-class participation shares (``fairness:*``) come from the
+    # fleet ledger's final health snapshot; deliberately NOT
+    # absence-is-zero — see slo.derive_fairness_metrics
+    fleet_health = None
+    fleet_health_path = os.path.join(artifacts, "fleet_health.json")
+    if os.path.exists(fleet_health_path):
+        with open(fleet_health_path, encoding="utf-8") as fh:
+            fleet_health = json.load(fh)
     try:
         report = evaluate_slo(
             scenario.slo, records, snapshot,
@@ -93,6 +107,8 @@ def main(argv=None) -> int:
             edge_snapshot=edge_snapshot,
             history=history,
             alert_events=alert_events,
+            fleet_health=fleet_health,
+            runbook_events=runbook_events,
             n_torn=n_torn,
             exclude_rounds=summary["warmup_round_names"],
             scenario_name=scenario.name,

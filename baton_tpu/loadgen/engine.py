@@ -42,7 +42,11 @@ plus — when the scenario's ``alerts`` block is enabled (the default) —
 ``alerts.jsonl`` (the manager's alert lifecycle stream, backing the SLO
 evaluator's ``alert:*`` namespace), ``alerts_status.json`` (the final
 ``GET /alerts`` snapshot), ``forensics_index.json`` and a
-``forensics/`` directory of content-addressed bundles.
+``forensics/`` directory of content-addressed bundles. When the
+scenario's ``runbooks`` block is enabled (opt-in), the manager also
+writes ``runbooks.jsonl`` (the actuation lifecycle stream, backing the
+SLO evaluator's ``runbook:*`` namespace) and the driver scrapes the
+final ``GET /runbooks`` snapshot into ``runbooks_status.json``.
 """
 
 from __future__ import annotations
@@ -178,6 +182,7 @@ class ScenarioRunner:
         self._topology: Optional[EdgeTopology] = None
         self.rounds_path = os.path.join(artifacts_dir, "rounds.jsonl")
         self.alerts_path = os.path.join(artifacts_dir, "alerts.jsonl")
+        self.runbooks_path = os.path.join(artifacts_dir, "runbooks.jsonl")
         self._rng = random.Random(scenario.seed)
         self._nprng = np.random.default_rng(scenario.seed)
         self._slots: List[_WorkerSlot] = []
@@ -595,6 +600,20 @@ class ScenarioRunner:
             )
         else:
             alerts_kwargs = dict(alert_rules=(), alerts_interval_s=0.0)
+        runbooks_kwargs = {}
+        if scn.runbooks.enabled:
+            # actuation rides the alert evaluator's tick; rules=None
+            # loads the manager's default remediation pack (already
+            # validated at scenario load, same contract as alerts)
+            runbooks_kwargs = dict(
+                runbook_rules=("default" if scn.runbooks.rules is None
+                               else [dict(r) for r in scn.runbooks.rules]),
+                runbooks_log_path=self.runbooks_path,
+            )
+            if not scn.alerts.enabled:
+                # the runbook engine evaluates on the alerts tick —
+                # keep the tick alive even with alerting itself off
+                alerts_kwargs["alerts_interval_s"] = scn.alerts.interval_s
         ha_kwargs = {}
         if standby_ports:
             # replicated control plane: the active journals every round
@@ -627,6 +646,7 @@ class ScenarioRunner:
             streaming_aggregation=scn.manager.streaming_aggregation,
             rounds_log_path=self.rounds_path,
             **alerts_kwargs,
+            **runbooks_kwargs,
             **ha_kwargs,
         )
         mrunner = web.AppRunner(mapp)
@@ -765,6 +785,16 @@ class ScenarioRunner:
                     fleet_health = await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError):
             pass
+        runbooks_status = None
+        if scn.runbooks.enabled:
+            try:
+                async with self._session.get(
+                    f"http://127.0.0.1:{self._mport}/{scn.name}/runbooks"
+                ) as resp:
+                    if resp.status == 200:
+                        runbooks_status = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                pass
         alerts_status = None
         forensics_index = None
         if scn.alerts.enabled:
@@ -825,6 +855,8 @@ class ScenarioRunner:
             self._write_json("fleet_health.json", fleet_health)
         if alerts_status is not None:
             self._write_json("alerts_status.json", alerts_status)
+        if runbooks_status is not None:
+            self._write_json("runbooks_status.json", runbooks_status)
         if forensics_index is not None:
             self._write_json("forensics_index.json", forensics_index)
         self._write_json("scenario_summary.json", summary)

@@ -487,6 +487,37 @@ class AlertsSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class RunbooksSpec:
+    """The manager's autonomous-runbook plane for this run. Off by
+    default — actuation is opt-in per scenario. ``rules: null`` with
+    ``enabled: true`` loads the default pack from
+    :mod:`baton_tpu.obs.runbooks`; an explicit list replaces it and
+    every rule is validated by :meth:`RunbookRule.parse` **at scenario
+    load**, same contract as :class:`AlertsSpec`."""
+
+    enabled: bool = False
+    rules: Optional[Tuple[Dict[str, Any], ...]] = None
+
+    @staticmethod
+    def parse(d: Dict[str, Any]) -> "RunbooksSpec":
+        ctx = "runbooks"
+        f = _take(d, ctx, enabled=False, rules=None)
+        raw_rules = f["rules"]
+        rules: Optional[Tuple[Dict[str, Any], ...]] = None
+        if raw_rules is not None:
+            if not isinstance(raw_rules, list):
+                raise ScenarioError(f"{ctx}: `rules` must be a list or null")
+            from baton_tpu.obs.runbooks import RunbookRule, RunbookRuleError
+            for i, rd in enumerate(raw_rules):
+                try:
+                    RunbookRule.parse(rd, ctx=f"{ctx}.rules[{i}]")
+                except RunbookRuleError as exc:
+                    raise ScenarioError(str(exc)) from exc
+            rules = tuple(dict(rd) for rd in raw_rules)
+        return RunbooksSpec(enabled=bool(f["enabled"]), rules=rules)
+
+
+@dataclasses.dataclass(frozen=True)
 class Scenario:
     name: str
     seed: int
@@ -498,6 +529,7 @@ class Scenario:
     slo: SLOSpec
     edges: EdgeSpec = EdgeSpec()
     alerts: AlertsSpec = AlertsSpec()
+    runbooks: RunbooksSpec = RunbooksSpec()
 
     @property
     def total_s(self) -> float:
@@ -522,7 +554,7 @@ class Scenario:
 def parse_scenario(d: Dict[str, Any], base_dir: str = ".") -> Scenario:
     f = _take(d, "scenario", name=None, seed=0, model=None, workers=None,
               manager=None, rounds=None, phases=None, slo=None, edges=None,
-              alerts=None)
+              alerts=None, runbooks=None)
     name = f["name"]
     if not isinstance(name, str) or not _NAME_RE.match(name):
         raise ScenarioError(
@@ -566,6 +598,7 @@ def parse_scenario(d: Dict[str, Any], base_dir: str = ".") -> Scenario:
         slo=SLOSpec.parse(f["slo"] or {}, base_dir),
         edges=edges,
         alerts=AlertsSpec.parse(f["alerts"] or {}),
+        runbooks=RunbooksSpec.parse(f["runbooks"] or {}),
     )
 
 

@@ -43,6 +43,20 @@ flat ``{metric_name: float}`` namespace:
     counters — "the run fired no alerts" is a real, assertable zero
     (``{"metric": "alert:fired_total", "op": "==", "value": 0}`` is the
     quiet-fleet gate).
+``runbook:*``
+    Derived from the manager's ``runbooks.jsonl`` lifecycle stream and
+    the per-round ``actuations`` records
+    (``baton_tpu.obs.runbooks``): ``runbook:entered:<rule>`` /
+    ``runbook:exited:<rule>`` transition counts (exited ≥ 1 is the
+    hysteresis-reversal proof), ``runbook:entered_total`` /
+    ``runbook:exited_total``, ``runbook:actuated_rounds:<action>``, and
+    ``runbook:actuations_total``. Absence-is-zero like counters.
+``fairness:*``
+    Per-class participation shares from ``fleet_health.json`` —
+    ``fairness:share:<class>``, ``fairness:share_per_client:<class>``,
+    ``fairness:clients:<class>``, ``fairness:participation_floor``
+    (see :func:`derive_fairness_metrics`). NOT absence-is-zero: the
+    starvation gate must fail loudly if fairness went unmeasured.
 ``compute:*``
     Derived from the ``compute`` section the manager folds into every
     round record (obs/compute.py): ``rounds_with_compute``,
@@ -137,7 +151,7 @@ def resolve_metric(metrics: Dict[str, float], name: str) -> Optional[float]:
     if val is not None:
         return val
     if name.startswith(("counter:", "fleet:counter:", "edge:counter:",
-                        "loadgen:", "alert:")):
+                        "loadgen:", "alert:", "runbook:")):
         return 0.0
     return None
 
@@ -283,6 +297,117 @@ def derive_alert_metrics(events: Optional[List[dict]]) -> Dict[str, float]:
         elif ev == "forensics":
             m["alert:forensics_bundles"] = (
                 m.get("alert:forensics_bundles", 0.0) + 1
+            )
+    return m
+
+
+def derive_fairness_metrics(fleet_health: Optional[dict]) -> Dict[str, float]:
+    """``fairness:*`` participation-share metrics from the manager's
+    ``fleet/health`` snapshot (``fleet_health.json``).
+
+    The runbook cohort bias must speed rounds up WITHOUT starving slow
+    clients, so the gate needs a number for "how much of the run's
+    participation each health class actually got":
+
+    ``fairness:share:<class>``
+        Fraction of all reported updates contributed by that class
+        (non-inactive classes only — an inactive client isn't being
+        starved by selection, it left).
+    ``fairness:clients:<class>``
+        Non-inactive client count per class.
+    ``fairness:share_per_client:<class>``
+        Class share normalized by class size — comparable across
+        classes of different sizes; under uniform selection every class
+        reads ≈ ``1/total_clients``.
+    ``fairness:participation_floor``
+        ``min over classes`` of ``share_per_client · total_clients`` —
+        1.0 is perfectly proportional participation, and the skew
+        scenario asserts this stays above a floor while bias is active.
+
+    NOT absence-is-zero: a run with no health snapshot (or no reports)
+    resolves these missing, and an asserted floor then fails — "we
+    stopped measuring fairness" must not pass vacuously."""
+    m: Dict[str, float] = {}
+    clients = (fleet_health or {}).get("clients") or {}
+    shares: Dict[str, float] = {}
+    counts: Dict[str, float] = {}
+    total_reported = 0.0
+    for info in clients.values():
+        if not isinstance(info, dict):
+            continue
+        status = info.get("status")
+        if not isinstance(status, str) or status == "inactive":
+            continue
+        rep = info.get("reported")
+        rep = float(rep) if isinstance(rep, (int, float)) else 0.0
+        shares[status] = shares.get(status, 0.0) + rep
+        counts[status] = counts.get(status, 0.0) + 1.0
+        total_reported += rep
+    if not counts or total_reported <= 0:
+        return m
+    total_clients = sum(counts.values())
+    floor = None
+    for status in sorted(counts):
+        share = shares.get(status, 0.0) / total_reported
+        per_client = share / counts[status]
+        m[f"fairness:share:{status}"] = share
+        m[f"fairness:clients:{status}"] = counts[status]
+        m[f"fairness:share_per_client:{status}"] = per_client
+        ratio = per_client * total_clients
+        floor = ratio if floor is None else min(floor, ratio)
+    if floor is not None:
+        m["fairness:participation_floor"] = floor
+    return m
+
+
+def derive_runbook_metrics(
+    events: Optional[List[dict]],
+    records: Optional[List[dict]] = None,
+) -> Dict[str, float]:
+    """``runbook:*`` metrics from the ``runbooks.jsonl`` lifecycle
+    stream (``baton_tpu.obs.runbooks``) plus the per-round
+    ``actuations`` records in ``rounds.jsonl``.
+
+    ``runbook:entered:<rule>`` / ``runbook:exited:<rule>`` count one
+    rule's activation/hysteresis-exit transitions (entered AND exited
+    ≥1 is the reversibility proof); ``runbook:entered_total`` /
+    ``runbook:exited_total`` sum across rules;
+    ``runbook:actuated_rounds:<action>`` counts rounds whose record
+    carries at least one applied actuation of that action, and
+    ``runbook:actuations_total`` counts every applied actuation.
+    Absence-is-zero like counters — "the run never remediated" is a
+    real, assertable zero."""
+    m: Dict[str, float] = {}
+    for e in events or []:
+        if not isinstance(e, dict):
+            continue
+        ev = e.get("event")
+        rule = e.get("rule")
+        if ev == "entered" and rule:
+            m[f"runbook:entered:{rule}"] = (
+                m.get(f"runbook:entered:{rule}", 0.0) + 1
+            )
+            m["runbook:entered_total"] = m.get("runbook:entered_total", 0.0) + 1
+        elif ev == "exited" and rule:
+            m[f"runbook:exited:{rule}"] = (
+                m.get(f"runbook:exited:{rule}", 0.0) + 1
+            )
+            m["runbook:exited_total"] = m.get("runbook:exited_total", 0.0) + 1
+    for r in records or []:
+        acts = r.get("actuations")
+        if not isinstance(acts, list):
+            continue
+        seen_actions = set()
+        for a in acts:
+            if not isinstance(a, dict) or not a.get("action"):
+                continue
+            m["runbook:actuations_total"] = (
+                m.get("runbook:actuations_total", 0.0) + 1
+            )
+            seen_actions.add(a["action"])
+        for action in seen_actions:
+            m[f"runbook:actuated_rounds:{action}"] = (
+                m.get(f"runbook:actuated_rounds:{action}", 0.0) + 1
             )
     return m
 
@@ -529,6 +654,8 @@ def evaluate_slo(
     edge_snapshot: Optional[dict] = None,
     history: Optional[List[dict]] = None,
     alert_events: Optional[List[dict]] = None,
+    fleet_health: Optional[dict] = None,
+    runbook_events: Optional[List[dict]] = None,
     baseline: Optional[dict] = None,
     n_torn: int = 0,
     exclude_rounds: Iterable[str] = (),
@@ -549,6 +676,10 @@ def evaluate_slo(
         metrics.update(derive_history_metrics(history))
     if alert_events is not None:
         metrics.update(derive_alert_metrics(alert_events))
+    if fleet_health is not None:
+        metrics.update(derive_fairness_metrics(fleet_health))
+    if runbook_events is not None:
+        metrics.update(derive_runbook_metrics(runbook_events, kept))
     compute_metrics, compute_skips = derive_compute_metrics(kept)
     metrics.update(compute_metrics)
     assertions = check_assertions(slo.assertions, metrics)

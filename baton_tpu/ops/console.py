@@ -83,6 +83,8 @@ def poll_node(
         "health": health,
         # alerting plane (None against a pre-alerts node — renderable)
         "alerts": fetch_json(f"{base}/alerts", timeout_s),
+        # runbook/actuation plane (None against a pre-runbooks node)
+        "runbooks": fetch_json(f"{base}/runbooks", timeout_s),
         # replication plane (None against a non-HA node — renderable)
         "replication": fetch_json(f"{base}/replication", timeout_s),
     }
@@ -254,6 +256,38 @@ def _alert_pane(state: dict, paint) -> List[str]:
     return lines
 
 
+def _runbook_pane(state: dict, paint) -> List[str]:
+    """The actuations pane: ACTIVE remediations first (painted — an
+    actuating fleet is a fleet being steered), then pending ones, each
+    with its trigger and how many times the manager applied it; silent
+    when no rule is loaded or everything is idle."""
+    lines: List[str] = []
+    rows: List[tuple] = []
+    for node in [state["root"]] + list(state["edges"]):
+        rb = node.get("runbooks") or {}
+        label = rb.get("node", node["url"])
+        for rule in rb.get("rules") or []:
+            if rule.get("state") in ("active", "pending"):
+                rows.append((0 if rule["state"] == "active" else 1,
+                             label, rule))
+    if not rows:
+        return lines
+    rows.sort(key=lambda r: (r[0], r[1], r[2].get("name", "")))
+    lines.append("  actuations:")
+    for _, label, rule in rows:
+        text = (
+            f"    {rule.get('state', '?').upper():<8} "
+            f"{label}: {rule.get('name')} -> {rule.get('action')} "
+            f"(on {rule.get('trigger')}; value={rule.get('value')}, "
+            f"applied={rule.get('actuations', 0)}x, "
+            f"episodes={rule.get('episodes', 0)})"
+        )
+        if rule.get("state") == "active":
+            text = paint("degrading", text)
+        lines.append(text)
+    return lines
+
+
 def _client_rows(health: Optional[dict], via: str) -> List[tuple]:
     rows = []
     for cid, info in ((health or {}).get("clients") or {}).items():
@@ -320,6 +354,9 @@ def render(state: dict, color: bool = True) -> str:
     alert_lines = _alert_pane(state, paint)
     if alert_lines:
         lines.extend(alert_lines)
+    runbook_lines = _runbook_pane(state, paint)
+    if runbook_lines:
+        lines.extend(runbook_lines)
 
     summary = ((root.get("health") or {}).get("summary")) or {}
     if summary:

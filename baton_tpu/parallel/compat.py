@@ -11,6 +11,8 @@ import (and with it test collection) on 0.4.37.
 
 from __future__ import annotations
 
+import inspect
+
 import jax
 from jax import lax
 
@@ -18,6 +20,21 @@ _NATIVE = hasattr(jax, "shard_map")
 
 if not _NATIVE:
     from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    # Transitional 0.4.x/0.5.x releases grew ``check_vma`` (and with it
+    # the varying-manifest checker) on the *experimental* entry point
+    # before shard_map moved to the jax namespace. Detect it once at
+    # import: with check_vma present the checker understands lax.pcast
+    # manifests, so the caller's intent can pass through instead of the
+    # blanket check_rep=False we need on genuinely old checkers.
+    try:
+        _EXPERIMENTAL_HAS_VMA = "check_vma" in inspect.signature(
+            _experimental_shard_map
+        ).parameters
+    except (TypeError, ValueError):
+        _EXPERIMENTAL_HAS_VMA = False
+else:
+    _EXPERIMENTAL_HAS_VMA = False
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
@@ -29,7 +46,14 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
             f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=check_vma, **kwargs,
         )
-    # check_rep is always disabled on the fallback path: the legacy
+    if _EXPERIMENTAL_HAS_VMA:
+        # manifest-aware fallback: re-enable the replication checker
+        # with the caller's setting instead of unconditionally off
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kwargs,
+        )
+    # check_rep is disabled on the legacy fallback path: that
     # replication checker predates lax.pcast, so code annotated for the
     # varying-manifest world (ring_attention's per-step lax.cond) trips
     # it with false "mismatched replication types" errors.

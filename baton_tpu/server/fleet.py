@@ -378,6 +378,13 @@ class ClientLedger:
                     o.get("outcome") == "straggler" for o in win
                 ),
                 "missed": sum(o.get("outcome") == "missed" for o in win),
+                # windowed recompile-storm count: the pin_shapes runbook
+                # quarantines exactly the clients whose storms triggered
+                # the alert, so the offender set must come from the same
+                # ledger window the classification does
+                "storms": sum(
+                    1 for o in win if o.get("recompile_storm")
+                ),
                 "last_round": last.get("round"),
                 "last_outcome": last.get("outcome"),
                 "last_ts": last.get("ts"),

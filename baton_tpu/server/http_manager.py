@@ -96,6 +96,7 @@ from baton_tpu.core.model import FedModel
 from baton_tpu.obs import alerts as obs_alerts
 from baton_tpu.obs import compute as obs_compute
 from baton_tpu.obs import forensics as obs_forensics
+from baton_tpu.obs import runbooks as obs_runbooks
 from baton_tpu.ops import aggregation as agg
 from baton_tpu.server import replication, wire
 from baton_tpu.server.blobs import BlobStore
@@ -284,6 +285,8 @@ class Experiment:
         alerts_rounds_window: int = 8,
         forensics_dir: Optional[str] = None,
         forensics_max_bundles: int = 16,
+        runbook_rules: Optional[Any] = None,
+        runbooks_log_path: Optional[str] = None,
         retention_interval_s: float = 60.0,
         trace_spool_max_age_s: float = 3600.0,
         trace_spool_max_files: int = 512,
@@ -432,6 +435,22 @@ class Experiment:
         content-addressed under ``forensics_dir`` (in-memory-only when
         unset) and served at ``GET /{name}/forensics/{digest}``; at
         most ``forensics_max_bundles`` are retained.
+
+        ``runbook_rules``: declarative remediation rules
+        (:mod:`baton_tpu.obs.runbooks`) the manager ACTUATES — biased/
+        over-provisioned cohort sampling, adaptive round deadlines,
+        FedBuff-style early finish, recompile-storm shape pinning —
+        evaluated on the alerting tick against the alert view plus the
+        fleet ledger's ``fleet.*`` classification metrics. Unlike
+        alerts, runbooks are opt-in: ``None`` (default) disables
+        actuation entirely (``GET /{name}/runbooks`` stays up);
+        ``"default"`` selects
+        :data:`~baton_tpu.obs.runbooks.DEFAULT_RUNBOOKS`. Every
+        applied actuation is stamped into the round's ``rounds.jsonl``
+        record (``actuations``) with its triggering alert/metric, and
+        rule enter/exit transitions append to ``runbooks_log_path``
+        (``runbooks.jsonl``). Actuation is an advisory plane: any
+        runbook failure falls back to the un-actuated behavior.
 
         Retention: every ``retention_interval_s`` a background task
         GCs the trace spool (age ``trace_spool_max_age_s`` / count
@@ -667,6 +686,21 @@ class Experiment:
             rounds_window=alerts_rounds_window,
             on_capture=self._arm_forensics,
         )
+        # runbook plane (obs/runbooks.py): remediations the manager
+        # actually applies. Opt-in, unlike alerts — observation is free,
+        # actuation changes round behavior, so None means NO rules.
+        if runbook_rules == "default":
+            runbook_rules = obs_runbooks.DEFAULT_RUNBOOKS
+        self.runbooks = obs_runbooks.RunbookEngine(
+            runbook_rules or (),
+            log_path=runbooks_log_path,
+            metrics=self.metrics,
+            node="manager",
+        )
+        # actuations applied to the round in flight, stamped into its
+        # rounds.jsonl record by _finish_round_obs (the explainability
+        # contract: every actuation names its trigger)
+        self._pending_actuations: list = []
         # the notify fan-out of the round in flight (participation
         # denominator for the ledger's missed-round accounting)
         self._round_cohort: list = []
@@ -1111,7 +1145,10 @@ class Experiment:
                 self._watchdog_tick, max(self.rounds.round_timeout / 4, 0.25)
             )
             self._background.append(watchdog.start())
-        if self.alerts.rules and self.alerts_interval_s > 0:
+        if (
+            (self.alerts.rules or self.runbooks.rules)
+            and self.alerts_interval_s > 0
+        ):
             alerts_task = PeriodicTask(
                 self._alerts_tick, self.alerts_interval_s
             )
@@ -1172,6 +1209,7 @@ class Experiment:
     async def _alerts_tick(self) -> None:
         # advisory plane: any failure is logged and counted, never
         # propagated — same contract as the fleet ledger
+        view: Optional[dict] = None
         try:
             view = obs_alerts.build_metric_view(
                 self.metrics_snapshot(),
@@ -1182,6 +1220,20 @@ class Experiment:
         except Exception:
             self.metrics.inc("alerts_eval_errors")
             _log.exception("%s: alert evaluation tick failed", self.name)
+        if not self.runbooks.rules:
+            return
+        # runbook plane rides the same tick: the runbook view is the
+        # alert view plus the ledger's fleet.* classification metrics,
+        # and alert-triggered rules follow the engine's firing set
+        try:
+            rb_view = dict(view or {})
+            rb_view.update(
+                obs_runbooks.derive_fleet_view(self.fleet.classify_all())
+            )
+            self.runbooks.evaluate(rb_view, firing=self.alerts.firing())
+        except Exception:
+            self.metrics.inc("runbooks_eval_errors")
+            _log.exception("%s: runbook evaluation tick failed", self.name)
 
     async def _retention_tick(self) -> None:
         """Bound the on-disk observability artifacts: trace-spool GC
@@ -1258,6 +1310,8 @@ class Experiment:
         # alerting plane: rule states + firing/pending lists; forensics
         # bundles by content digest
         r.add_get(f"/{self.name}/alerts", self.handle_alerts)
+        # runbook plane: rule states + per-rule actuation counts
+        r.add_get(f"/{self.name}/runbooks", self.handle_runbooks)
         r.add_get(f"/{self.name}/forensics", self.handle_forensics_index)
         r.add_get(
             f"/{self.name}/forensics/{{digest}}", self.handle_forensics
@@ -1504,6 +1558,97 @@ class Experiment:
         """``GET /{name}/alerts`` — every rule's lifecycle state, last
         value, and recent transitions, plus the firing/pending lists."""
         return web.json_response(json_clean(self.alerts.status_snapshot()))
+
+    # -- runbook plane -------------------------------------------------
+    async def handle_runbooks(self, request: web.Request) -> web.Response:
+        """``GET /{name}/runbooks`` — every remediation rule's state,
+        trigger, params, and how often the manager applied it."""
+        return web.json_response(
+            json_clean(self.runbooks.status_snapshot())
+        )
+
+    def _record_actuation(self, act: dict, detail: dict) -> None:
+        """One applied remediation → the round's explainability record
+        (``rounds.jsonl`` ``actuations`` entry names the rule AND its
+        triggering alert/classification) + the engine's counter."""
+        entry = {
+            "action": act["action"],
+            "rule": act["rule"],
+            "trigger": act["trigger"],
+            "value": act.get("value"),
+            "detail": detail,
+        }
+        self._pending_actuations.append(entry)
+        self.runbooks.record_actuation(act["rule"])
+
+    def _apply_round_deadline(self) -> None:
+        """``adaptive_deadline`` actuation: fit THIS round's reporting
+        deadline from the fleet's observed per-client ``train_s``
+        medians instead of the static ``round_timeout``. Advisory —
+        requires a configured ``round_timeout`` (that is what starts
+        the expiry watchdog) and any failure keeps the static value."""
+        try:
+            act = self.runbooks.actuation("adaptive_deadline")
+            if act is None or self.rounds.round_timeout is None:
+                return
+            p = act["params"]
+            classified = self.fleet.classify_all()
+            max_s = p.get("max_s")
+            if max_s is None:
+                # bound a bad fit: never hold a round open past 4x the
+                # operator's static timeout
+                max_s = 4.0 * self.rounds.round_timeout
+            deadline = obs_runbooks.fit_deadline(
+                (c.get("train_s_median") for c in classified.values()),
+                quantile=p["quantile"],
+                margin=p["margin"],
+                min_s=p.get("min_s"),
+                max_s=max_s,
+            )
+            if deadline is None:
+                return
+            self.rounds.set_deadline(deadline)
+            self._record_actuation(act, {
+                "deadline_s": round(deadline, 6),
+                "base_timeout_s": self.rounds.round_timeout,
+                "clients_fit": sum(
+                    1 for c in classified.values()
+                    if c.get("train_s_median") is not None
+                ),
+            })
+        except Exception:
+            self.metrics.inc("runbooks_eval_errors")
+            _log.exception(
+                "%s: adaptive_deadline actuation failed", self.name
+            )
+
+    def _fedbuff_buffer_full(self) -> bool:
+        """``fedbuff_fallback`` actuation: under churn, finish the
+        round as soon as a FedBuff-style buffer of
+        ``ceil(buffer_frac · cohort)`` reports has landed instead of
+        waiting out the stragglers."""
+        try:
+            act = self.runbooks.actuation("fedbuff_fallback")
+            if act is None:
+                return False
+            cohort = len(self.rounds.clients)
+            need = max(1, math.ceil(act["params"]["buffer_frac"] * cohort))
+            have = len(self.rounds.client_responses)
+            if have < need:
+                return False
+            self._record_actuation(act, {
+                "buffered": have,
+                "required": need,
+                "cohort": cohort,
+                "cut_stragglers": sorted(
+                    set(self.rounds.clients)
+                    - set(self.rounds.client_responses)
+                ),
+            })
+            return True
+        except Exception:
+            self.metrics.inc("runbooks_eval_errors")
+            return False
 
     async def handle_forensics_index(
         self, request: web.Request
@@ -1758,6 +1903,12 @@ class Experiment:
             "phase_s": phases,
             "compute": compute_section,
         }
+        # explainability contract: every remediation the manager applied
+        # during this round lands in the round's own record, naming the
+        # rule AND the alert/classification that triggered it
+        acts, self._pending_actuations = self._pending_actuations, []
+        if acts:
+            record["actuations"] = acts
         # mirrored for the alert evaluator's rounds.* tail (no file IO
         # on an evaluation tick) — kept even when rounds_log is off
         self._recent_rounds.append(record)
@@ -2479,6 +2630,10 @@ class Experiment:
     async def start_round(self, n_epoch: int) -> Dict[str, bool]:
         round_name = self.rounds.start_round(n_epoch=n_epoch)
         self._slo_base = self.metrics.snapshot()["counters"]
+        # actuations applied while THIS round runs; _finish_round_obs
+        # moves them into the round's rounds.jsonl record
+        self._pending_actuations = []
+        self._apply_round_deadline()
         trace_id = tracing.make_trace_id(self.name, round_name)
         self._secure_round = None  # invalidate any stale secure state
         # chunk sessions are per-round: a body assembled for the dead
@@ -2545,6 +2700,16 @@ class Experiment:
         )
         state_dict = params_to_state_dict(self.params)
         meta = {"update_name": round_name, "n_epoch": n_epoch}
+        # pin_shapes actuation: ask the cohort to hold batch/sequence
+        # shapes fixed for this round (workers that predate the key
+        # ignore it — the envelope parser reads only known fields)
+        try:
+            _pin_act = self.runbooks.actuation("pin_shapes")
+        except Exception:
+            _pin_act = None
+        if _pin_act is not None:
+            meta["pin_shapes"] = True
+            self._record_actuation(_pin_act, {"pin_shapes": True})
         encoding = None
         delta_tensors = None
         if self.broadcast_quantize_bits is not None:
@@ -2707,6 +2872,8 @@ class Experiment:
             envelope = self._publish_round_blobs(
                 round_name, n_epoch, state_dict, delta_tensors, encoding
             )
+            if meta.get("pin_shapes"):
+                envelope["pin_shapes"] = True
             if self._secure_round is not None:
                 # per-recipient envelopes: each cohort member's carries
                 # ITS inbox of sealed share boxes from the others
@@ -2836,13 +3003,108 @@ class Experiment:
     def _sample_cohort(self) -> list:
         """The round's notification cohort: all registered clients at
         ``cohort_fraction=1`` (reference behavior), else a uniform sample
-        of ``max(min_cohort, fraction * N)`` without replacement."""
+        of ``max(min_cohort, fraction * N)`` without replacement.
+
+        With runbook rules loaded, cohort selection is routed through
+        :meth:`_sample_cohort_runbooks`, which applies any active
+        ``pin_shapes`` quarantine / ``overprovision`` / ``bias_cohort``
+        actuations; a failure there falls back to this uniform path so a
+        runbook bug can never stop rounds from forming."""
+        if self.runbooks.rules:
+            try:
+                return self._sample_cohort_runbooks(
+                    list(self.registry.clients)
+                )
+            except Exception:
+                self.metrics.inc("runbooks_eval_errors")
+                _log.exception(
+                    "%s: runbook cohort selection failed — falling back "
+                    "to uniform sampling", self.name,
+                )
         ids = list(self.registry.clients)
         if self.cohort_fraction >= 1.0 or len(ids) <= self.min_cohort:
             return ids
         k = min(len(ids), max(self.min_cohort,
                               int(round(self.cohort_fraction * len(ids)))))
         return sorted(self._cohort_rng.sample(ids, k))
+
+    def _sample_cohort_runbooks(self, ids: list) -> list:
+        """Cohort selection under active runbook actuations.
+
+        Order matters and is part of the explainability contract:
+        ``pin_shapes`` first narrows eligibility (quarantine clients
+        whose recent windows carried recompile storms), then
+        ``overprovision`` inflates the invite count against expected
+        misses, then ``bias_cohort`` reweights the draw AWAY from
+        slow/flaky clients without ever hard-excluding them — every
+        client keeps a nonzero weight, so the fairness floor holds."""
+        classified = self.fleet.classify_all()
+        eligible = list(ids)
+
+        act = self.runbooks.actuation("pin_shapes")
+        if act is not None and act["params"].get("quarantine"):
+            offenders = {
+                cid for cid in eligible
+                if classified.get(cid, {}).get("storms")
+            }
+            kept = [cid for cid in eligible if cid not in offenders]
+            # never quarantine the round away: only narrow eligibility
+            # while a viable cohort remains
+            if offenders and len(kept) >= self.min_cohort:
+                eligible = kept
+                self._record_actuation(act, {
+                    "quarantined": sorted(offenders),
+                    "eligible": len(eligible),
+                })
+
+        if self.cohort_fraction >= 1.0 or len(eligible) <= self.min_cohort:
+            return sorted(eligible) if eligible is not ids else eligible
+        base_k = min(
+            len(eligible),
+            max(self.min_cohort,
+                int(round(self.cohort_fraction * len(eligible)))),
+        )
+        k = base_k
+
+        act = self.runbooks.actuation("overprovision")
+        if act is not None and base_k < len(eligible):
+            p = act["params"]
+            k, eps = obs_runbooks.overprovision_count(
+                base_k, len(eligible), float(act.get("value") or 0.0),
+                epsilon_max=p["epsilon_max"], gain=p["gain"],
+            )
+            if k > base_k:
+                self._record_actuation(act, {
+                    "base_k": base_k,
+                    "k": k,
+                    "epsilon": round(eps, 6),
+                    "miss_rate": act.get("value"),
+                })
+
+        if k >= len(eligible):
+            return sorted(eligible)
+
+        act = self.runbooks.actuation("bias_cohort")
+        if act is not None:
+            p = act["params"]
+            downweight = set(p["statuses"])
+            weights = {
+                cid: p["weight"]
+                for cid in eligible
+                if classified.get(cid, {}).get("status") in downweight
+            }
+            if weights:
+                picked = obs_runbooks.weighted_sample(
+                    eligible, weights, k, self._cohort_rng
+                )
+                self._record_actuation(act, {
+                    "weight": p["weight"],
+                    "downweighted": len(weights),
+                    "k": k,
+                })
+                return sorted(picked)
+
+        return sorted(self._cohort_rng.sample(eligible, k))
 
     def _secure_phase_budget_s(self) -> float:
         """Per-request timeout for the secure-protocol phases. The
@@ -3147,6 +3409,10 @@ class Experiment:
                 started_wall=started_wall,
             )
         elif self.rounds.clients_left == 0:
+            self.end_round()
+        elif self._fedbuff_buffer_full():
+            # fedbuff_fallback actuation: under churn, a buffer's worth
+            # of reports is the round — don't wait out the stragglers
             self.end_round()
 
     def end_round(self) -> None:

@@ -42,16 +42,20 @@ Design points:
 Auth keys are what make "workers keep their credentials across a
 manager restart" possible, so they must be journaled — but not in the
 clear: when ``BATON_JOURNAL_KEY`` is set (a passphrase, or a path to a
-file holding one) every ``key`` field is wrapped at the append/compact
-boundary (``enc1:`` envelope: HMAC-SHA256 keystream + truncated-HMAC
-tag, stdlib only) and unwrapped transparently on load. Legacy
-plaintext journals keep reading as-is — migration is "set the env var
-and let the next compaction rewrite the snapshot". A wrapped key that
-cannot be unwrapped (env var lost, or wrong) degrades to ``None``:
-the client re-registers instead of anyone trusting an unverifiable
-credential. Replication (:mod:`baton_tpu.server.replication`) ships
-journal bytes verbatim, so standbys see only wrapped keys on the wire
-and need the same ``BATON_JOURNAL_KEY`` to serve after promotion.
+file holding one) every ``key`` field — and the ``data`` body of
+``update_payload`` events, which carries a client's model update —
+is wrapped at the append/compact boundary (``enc1:`` envelope:
+HMAC-SHA256 keystream + truncated-HMAC tag, stdlib only) and unwrapped
+transparently on load. Legacy plaintext journals keep reading as-is —
+migration is "set the env var and let the next compaction rewrite the
+snapshot". A wrapped key that cannot be unwrapped (env var lost, or
+wrong) degrades to ``None``: the client re-registers instead of anyone
+trusting an unverifiable credential; an unverifiable payload likewise
+degrades to None, so recovery rebroadcasts the round rather than
+replaying bytes it cannot authenticate. Replication
+(:mod:`baton_tpu.server.replication`) ships journal bytes verbatim, so
+standbys see only wrapped keys/payloads on the wire and need the same
+``BATON_JOURNAL_KEY`` to serve after promotion.
 """
 
 from __future__ import annotations
@@ -175,6 +179,13 @@ class Journal:
         if self._wrap_key is not None and isinstance(fields.get("key"), str):
             fields = dict(fields, key=wrap_value(fields["key"],
                                                  self._wrap_key))
+        if (self._wrap_key is not None and event == "update_payload"
+                and isinstance(fields.get("data"), str)):
+            # a journaled upload body is model-update content — at rest
+            # it gets the same envelope as auth keys, and the WAL ships
+            # it wrapped so standbys never hold plaintext training bytes
+            fields = dict(fields, data=wrap_value(fields["data"],
+                                                  self._wrap_key))
         rec = {"event": event, **fields}
         self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
         self._fh.flush()
@@ -233,6 +244,11 @@ class Journal:
         for rec in events:
             if "key" in rec:
                 rec["key"] = unwrap_value(rec["key"], self._wrap_key)
+            if rec.get("event") == "update_payload" and "data" in rec:
+                # unverifiable body → None → replay keeps the event but
+                # _resume_round sees no payload and rebroadcasts; the
+                # round degrades to re-training, never to bad tensors
+                rec["data"] = unwrap_value(rec["data"], self._wrap_key)
         return snapshot, events
 
     def recover(self) -> "RecoveredState":

@@ -71,6 +71,10 @@ class RoundManager:
         self.update_ids: Dict[str, str] = {}
         self.round_meta: Optional[dict] = None
         self.started_at: Optional[float] = None
+        # per-round deadline override (runbook adaptive_deadline
+        # actuation); cleared with the rest of the round state so an
+        # actuated deadline never outlives the round it was fit for
+        self.deadline_override: Optional[float] = None
         # wall-clock (epoch) round start: the injected monotonic clock
         # is the right base for expiry math but meaningless across
         # processes — trace spans and rounds.jsonl SLO records need a
@@ -91,11 +95,33 @@ class RoundManager:
         return len(self.clients) - len(self.client_responses)
 
     @property
+    def effective_timeout(self) -> Optional[float]:
+        """The deadline the running round is actually held to: the
+        per-round :meth:`set_deadline` override when one was actuated,
+        else the static ``round_timeout``."""
+        if self.deadline_override is not None:
+            return self.deadline_override
+        return self.round_timeout
+
+    @property
     def is_expired(self) -> bool:
-        """True when the running round has outlived ``round_timeout``."""
-        if not self._in_progress or self.round_timeout is None:
+        """True when the running round has outlived its deadline."""
+        timeout = self.effective_timeout
+        if not self._in_progress or timeout is None:
             return False
-        return self.elapsed > self.round_timeout
+        return self.elapsed > timeout
+
+    def set_deadline(self, seconds: Optional[float]) -> None:
+        """Override THIS round's straggler deadline (runbook
+        ``adaptive_deadline``). Applies to the running round only —
+        ``_reset_state`` clears it on start/abort, so the static
+        ``round_timeout`` is restored the moment the actuation stops
+        being re-applied. No-op outside a round."""
+        if not self._in_progress:
+            return
+        self.deadline_override = (
+            None if seconds is None else max(0.0, float(seconds))
+        )
 
     @property
     def elapsed(self) -> float:

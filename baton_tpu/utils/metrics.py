@@ -145,6 +145,11 @@ DECLARED_COUNTERS = frozenset({
     "alerts_eval_errors",
     "alerts_captures_armed",
     "alerts_captures_built",   # manager: forensics bundles materialized
+    # runbook/actuation plane (baton_tpu/obs/runbooks.py engine)
+    "runbooks_entered_total",    # rule transitions into ACTIVE
+    "runbooks_exited_total",     # hysteresis exits back to idle
+    "runbooks_eval_errors",      # advisory: evaluation/actuation failures
+    "runbooks_actuations_total",  # remediations actually applied to rounds
     # retention (trace-spool GC + jsonl rotation PeriodicTasks)
     "trace_spool_gc_removed",
     "jsonl_rotations",
@@ -252,6 +257,8 @@ DECLARED_GAUGES = frozenset({
     # alerting plane: current rule-state counts (obs/alerts.py engine)
     "alerts_firing",
     "alerts_pending",
+    # runbook plane: rules currently ACTIVE (obs/runbooks.py engine)
+    "runbooks_active",
     # compute plane (baton_tpu/obs/compute.py probe records; latest round)
     "compute_mfu",
     "compute_samples_per_sec_per_chip",

@@ -1163,6 +1163,113 @@ def test_btl033_audits_beyond_server_paths():
 
 
 # ----------------------------------------------------------------------
+# BTL034 — runbook rules: action catalog + per-action params + trigger
+# shape (the actuation half of BTL033's "typo parses fine, never fires")
+
+
+def test_btl034_flags_unknown_action_param_and_trigger():
+    findings = lint(
+        """
+        RULES = [
+            {"name": "a", "action": "bias_cohorts",
+             "trigger": {"alert": "straggler_rate"}},
+            {"name": "b", "action": "overprovision",
+             "trigger": {"metric": "rounds.straggler_rate", "op": ">",
+                         "threshold": 0.15},
+             "params": {"epsilon": 0.3}},
+            {"name": "c", "action": "fedbuff_fallback",
+             "trigger": {"metric": "fleet.churn_fraction", "op": ">",
+                         "threshold": 0.34}},
+            {"name": "d", "action": "pin_shapes",
+             "trigger": {"alert": "recompile_storm", "op": ">"}},
+            {"name": "e", "action": "adaptive_deadline",
+             "trigger": {"metric": "train_p95", "op": ">",
+                         "threshold": 2.0}},
+        ]
+        """,
+        rules=["BTL034"],
+    )
+    assert rules_of(findings) == ["BTL034"] * 5
+    assert "bias_cohorts" in findings[0].message
+    assert "epsilon" in findings[1].message
+    assert "fleet.churn_fraction" in findings[2].message
+    assert "alert trigger" in findings[3].message
+    assert "evaluable" in findings[4].message
+
+
+def test_btl034_catalog_rules_pass():
+    findings = lint(
+        """
+        RULES = [
+            {"name": "bias", "action": "bias_cohort",
+             "trigger": {"alert": "straggler_rate"},
+             "params": {"weight": 0.25, "statuses": ["slow", "flaky"]}},
+            {"name": "over", "action": "overprovision",
+             "trigger": {"metric": "rounds.straggler_rate", "op": ">",
+                         "threshold": 0.15},
+             "params": {"epsilon_max": 0.5, "gain": 1.0}},
+            {"name": "dl", "action": "adaptive_deadline",
+             "trigger": {"metric": "rounds.straggler_rate", "op": ">",
+                         "threshold": 0.15},
+             "params": {"quantile": 0.95, "margin": 1.5}},
+            {"name": "buf", "action": "fedbuff_fallback",
+             "trigger": {"metric": "fleet.churn_frac", "op": ">",
+                         "threshold": 0.34},
+             "params": {"buffer_frac": 0.5}},
+            {"name": "pin", "action": "pin_shapes",
+             "trigger": {"alert": "recompile_storm"},
+             "cooldown_s": 60.0},
+        ]
+        """,
+        rules=["BTL034"],
+    )
+    assert findings == []
+
+
+def test_btl034_only_audits_rule_shaped_dicts():
+    findings = lint(
+        """
+        # actuation record: action but no name — out of scope
+        A = {"action": "bias_cohort", "rule": "bias", "detail": {}}
+        # name+action but no rule marker key — not a rule shape
+        B = {"name": "row", "action": "bias_cohort"}
+        # dynamic action: nothing checkable
+        def f(act):
+            return {"name": "dyn", "action": act, "cooldown_s": 5}
+        """,
+        rules=["BTL034"],
+    )
+    assert findings == []
+
+
+def test_btl034_mirror_matches_runtime_catalog():
+    # the checker duplicates the runtime literals so the analysis layer
+    # lints checkouts that don't import; this pins the two copies
+    from baton_tpu.analysis.checkers.runbooks import (
+        _ACTION_PARAM_KEYS,
+        _ACTIONS,
+        _FLEET_SERIES,
+    )
+    from baton_tpu.obs.runbooks import (
+        ACTION_PARAMS,
+        RUNBOOK_ACTIONS,
+        derive_fleet_view,
+    )
+    assert _ACTIONS == frozenset(RUNBOOK_ACTIONS)
+    assert {a: frozenset(p) for a, p in ACTION_PARAMS.items()} == dict(
+        _ACTION_PARAM_KEYS
+    )
+    view = derive_fleet_view({
+        "h": {"status": "healthy", "storms": 1},
+        "s": {"status": "slow"},
+        "f": {"status": "flaky"},
+        "d": {"status": "degrading"},
+        "i": {"status": "inactive"},
+    })
+    assert {k[len("fleet."):] for k in view} <= _FLEET_SERIES
+
+
+# ----------------------------------------------------------------------
 # compute-plane metric names — the probe's emission sites live under
 # server/, so a typo'd compute name would silently zero a gated
 # compute:* SLO metric; these fixtures pin the names BTL030/BTL032 must
@@ -1236,7 +1343,7 @@ def test_all_rules_table():
     table = all_rules()
     assert set(table) == {
         "BTL001", "BTL002", "BTL003", "BTL010", "BTL011", "BTL020",
-        "BTL030", "BTL031", "BTL032", "BTL033",
+        "BTL030", "BTL031", "BTL032", "BTL033", "BTL034",
     }
     assert all(table.values())
 

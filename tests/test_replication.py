@@ -498,6 +498,53 @@ def test_journal_key_wrapping_at_rest(monkeypatch):
         assert st.clients["c1"]["key"] == "plain"
 
 
+def test_journal_payload_wrapping_at_rest(monkeypatch):
+    """update_payload bodies (model-update bytes riding the WAL) get
+    the same enc1: envelope as auth keys: wrapped on append, unwrapped
+    on load, degraded to None (→ rebroadcast, not bad tensors) when
+    the key is wrong, and legacy plaintext payloads keep replaying."""
+    body = "UEsDBBQAAAAIAL-model-update-bytes"
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "wal.jsonl")
+        monkeypatch.setenv(WRAP_KEY_ENV, "hunter2")
+        j = Journal(path, fsync="never")
+        j.append("round_started", round_name="r1", meta={"n_epoch": 1})
+        j.append("round_client_joined", round_name="r1", client_id="c1")
+        j.append("update_accepted", round_name="r1", client_id="c1",
+                 update_id="u1", n_samples=8)
+        j.append("update_payload", round_name="r1", client_id="c1",
+                 data=body, content_type="application/zip")
+        j.close()
+        on_disk = open(path).read()
+        assert body not in on_disk
+        assert on_disk.count("enc1:") == 1  # only the payload body
+
+        st = Journal(path, fsync="never").recover()
+        assert st.open_round["payloads"]["c1"]["data"] == body
+        assert st.open_round["payloads"]["c1"]["content_type"] == (
+            "application/zip")
+
+        # wrong key: the body degrades to None; the event (and the
+        # round) still replays, so recovery rebroadcasts
+        monkeypatch.setenv(WRAP_KEY_ENV, "wrong")
+        st = Journal(path, fsync="never").recover()
+        assert st.open_round is not None
+        assert st.open_round["payloads"]["c1"]["data"] is None
+
+        # legacy plaintext payloads keep reading once a key appears
+        monkeypatch.delenv(WRAP_KEY_ENV)
+        legacy = os.path.join(td, "legacy.jsonl")
+        jl = Journal(legacy, fsync="never")
+        jl.append("round_started", round_name="r1", meta={"n_epoch": 1})
+        jl.append("round_client_joined", round_name="r1", client_id="c1")
+        jl.append("update_payload", round_name="r1", client_id="c1",
+                 data=body, content_type="application/zip")
+        jl.close()
+        monkeypatch.setenv(WRAP_KEY_ENV, "hunter2")
+        st = Journal(legacy, fsync="never").recover()
+        assert st.open_round["payloads"]["c1"]["data"] == body
+
+
 def test_wrap_value_roundtrip_and_tamper():
     import hashlib
 

@@ -1,0 +1,377 @@
+"""Runbook plane unit tests — rule parsing, the pure actuation helpers,
+the idle→active→idle hysteresis machine for every action, and the SLO
+evaluator's fairness/runbook namespaces.
+
+No federation: the engine is pure stdlib with an injected clock, and
+the fairness metrics take a fleet-health dict literal.
+"""
+
+import random
+
+import pytest
+
+from baton_tpu.loadgen.slo import (
+    derive_fairness_metrics,
+    derive_runbook_metrics,
+    resolve_metric,
+)
+from baton_tpu.obs.runbooks import (
+    ACTION_PARAMS,
+    DEFAULT_RUNBOOKS,
+    RUNBOOK_ACTIONS,
+    RunbookEngine,
+    RunbookRule,
+    RunbookRuleError,
+    derive_fleet_view,
+    fit_deadline,
+    overprovision_count,
+    read_runbooks_jsonl,
+    weighted_sample,
+)
+from baton_tpu.server.rounds import RoundManager
+from baton_tpu.utils.metrics import Metrics
+
+
+# ----------------------------------------------------------------------
+# parsing: strict like AlertRule — typos fail at load, not silently
+
+
+def _rule(**over):
+    d = {
+        "name": "r",
+        "action": "bias_cohort",
+        "trigger": {"alert": "straggler_rate"},
+    }
+    d.update(over)
+    return d
+
+
+def test_parse_default_pack_and_catalog():
+    engine = RunbookEngine(DEFAULT_RUNBOOKS)
+    assert sorted({r.action for r in engine.rules}) == sorted(RUNBOOK_ACTIONS)
+    # params merged over the per-action defaults
+    bias = next(r for r in engine.rules if r.action == "bias_cohort")
+    assert bias.params["weight"] == 0.25
+    assert set(bias.params) == set(ACTION_PARAMS["bias_cohort"])
+
+
+def test_parse_rejects_unknown_rule_key():
+    with pytest.raises(RunbookRuleError, match="unknown keys"):
+        RunbookRule.parse(_rule(severity="page"))
+
+
+def test_parse_rejects_unknown_action():
+    with pytest.raises(RunbookRuleError, match="action"):
+        RunbookRule.parse(_rule(action="bias_cohorts"))
+
+
+def test_parse_rejects_unknown_param_for_action():
+    with pytest.raises(RunbookRuleError, match="unknown params"):
+        RunbookRule.parse(_rule(params={"epsilon_max": 0.5}))
+
+
+def test_parse_rejects_starving_bias_weight():
+    # a zero weight would hard-evict; the whole point is it cannot
+    with pytest.raises(RunbookRuleError, match="weight"):
+        RunbookRule.parse(_rule(params={"weight": 0.0}))
+    with pytest.raises(RunbookRuleError, match="statuses"):
+        RunbookRule.parse(_rule(params={"statuses": ["inactive"]}))
+
+
+def test_parse_rejects_malformed_triggers():
+    with pytest.raises(RunbookRuleError, match="alert trigger"):
+        RunbookRule.parse(_rule(trigger={"alert": "x", "op": ">"}))
+    with pytest.raises(RunbookRuleError, match="unknown trigger keys"):
+        RunbookRule.parse(_rule(trigger={"metric": "fleet.churn_frac",
+                                         "threshold": 0.3,
+                                         "severity": "page"}))
+    # metric trigger validation is delegated to AlertRule (bad op)
+    with pytest.raises(RunbookRuleError, match="op"):
+        RunbookRule.parse(_rule(trigger={"metric": "fleet.churn_frac",
+                                         "op": "!!", "threshold": 0.3}))
+
+
+def test_engine_rejects_duplicate_names():
+    with pytest.raises(RunbookRuleError, match="duplicate"):
+        RunbookEngine([_rule(), _rule()])
+
+
+# ----------------------------------------------------------------------
+# pure helpers
+
+
+def test_weighted_sample_biases_but_never_excludes():
+    ids = [f"c{i}" for i in range(8)]
+    down = {"c0": 0.1, "c1": 0.1}
+    hits = {cid: 0 for cid in ids}
+    rng = random.Random(7)
+    for _ in range(600):
+        for cid in weighted_sample(ids, down, 4, rng):
+            hits[cid] += 1
+    # downweighted clients are picked much less than full-weight ones...
+    assert hits["c0"] < hits["c2"] / 2
+    # ...but never starved outright
+    assert hits["c0"] > 0 and hits["c1"] > 0
+    # k == len(ids) short-circuits to everyone
+    assert weighted_sample(ids, down, len(ids), rng) == ids
+
+
+def test_overprovision_count_tracks_miss_rate_and_caps():
+    k, eps = overprovision_count(10, 100, 0.2, epsilon_max=0.5, gain=1.0)
+    assert (k, eps) == (12, pytest.approx(0.2))
+    # epsilon capped
+    k, eps = overprovision_count(10, 100, 0.9, epsilon_max=0.5, gain=1.0)
+    assert (k, eps) == (15, pytest.approx(0.5))
+    # availability capped, never below the base k
+    k, _ = overprovision_count(10, 11, 0.9, epsilon_max=0.5, gain=1.0)
+    assert k == 11
+    k, _ = overprovision_count(10, 100, 0.0)
+    assert k == 10
+
+
+def test_fit_deadline_quantile_margin_and_clamps():
+    vals = [1.0, 2.0, 3.0, 4.0]
+    d = fit_deadline(vals, quantile=0.5, margin=2.0, min_s=0.1, max_s=None)
+    assert d == pytest.approx(5.0)  # median 2.5 * 2.0
+    assert fit_deadline(vals, quantile=0.5, margin=2.0,
+                        min_s=0.1, max_s=4.0) == pytest.approx(4.0)
+    assert fit_deadline([], quantile=0.5, margin=2.0) is None
+    # junk history (zeros, Nones) is not usable
+    assert fit_deadline([0.0, None], quantile=0.5, margin=2.0) is None
+
+
+def test_derive_fleet_view_fractions_over_active():
+    view = derive_fleet_view({
+        "a": {"status": "healthy"},
+        "b": {"status": "slow"},
+        "c": {"status": "flaky", "storms": 2},
+        "d": {"status": "degrading"},
+        "e": {"status": "inactive"},
+    })
+    assert view["fleet.clients"] == 5.0
+    assert view["fleet.active_clients"] == 4.0
+    assert view["fleet.slow_frac"] == pytest.approx(0.25)
+    assert view["fleet.churn_frac"] == pytest.approx(0.5)  # flaky+degrading
+    assert view["fleet.storm_clients"] == 1.0
+    assert derive_fleet_view({}) == {}
+
+
+# ----------------------------------------------------------------------
+# hysteresis: every action enters on breach and exits via the
+# clear_ratio machinery (or the alert's own resolved lifecycle)
+
+
+def _engine(rules, tmp_path=None, metrics=None):
+    t = [0.0]
+    eng = RunbookEngine(
+        rules,
+        log_path=(str(tmp_path / "runbooks.jsonl") if tmp_path else None),
+        metrics=metrics,
+        now=lambda: t[0],
+    )
+    return eng, t
+
+
+@pytest.mark.parametrize("action,alert", [
+    ("bias_cohort", "straggler_rate"),
+    ("pin_shapes", "recompile_storm"),
+])
+def test_alert_trigger_enters_and_exits_with_firing_set(action, alert):
+    eng, t = _engine([{
+        "name": "r", "action": action, "trigger": {"alert": alert},
+        "cooldown_s": 10.0,
+    }])
+    events = eng.evaluate({}, firing=[alert])
+    assert [e["event"] for e in events] == ["entered"]
+    assert eng.actuation(action)["trigger"] == f"alert:{alert}"
+    # alert resolved -> the actuation reverses
+    t[0] = 1.0
+    events = eng.evaluate({}, firing=[])
+    assert [e["event"] for e in events] == ["exited"]
+    assert eng.actuation(action) is None
+    # cooldown: an immediate re-fire does not re-enter...
+    t[0] = 2.0
+    assert eng.evaluate({}, firing=[alert]) == []
+    # ...until the cooldown elapses
+    t[0] = 20.0
+    assert [e["event"] for e in eng.evaluate({}, firing=[alert])] == [
+        "entered"]
+
+
+@pytest.mark.parametrize("action,metric,params", [
+    ("overprovision", "rounds.straggler_rate", None),
+    ("adaptive_deadline", "rounds.straggler_rate", None),
+    ("fedbuff_fallback", "fleet.churn_frac", {"buffer_frac": 0.5}),
+])
+def test_metric_trigger_hysteresis_band(action, metric, params):
+    rule = {
+        "name": "r", "action": action, "cooldown_s": 0.0,
+        "trigger": {"metric": metric, "op": ">", "threshold": 0.2},
+    }
+    if params:
+        rule["params"] = params
+    eng, t = _engine([rule])
+    assert [e["event"] for e in eng.evaluate({metric: 0.3})] == ["entered"]
+    # inside the hysteresis band (clear = 0.9 * threshold): still active
+    t[0] = 1.0
+    assert eng.evaluate({metric: 0.19}) == []
+    assert eng.active() == ["r"]
+    # below the clear threshold: exits
+    t[0] = 2.0
+    assert [e["event"] for e in eng.evaluate({metric: 0.1})] == ["exited"]
+    assert eng.active() == []
+
+
+def test_for_s_holds_entry_until_sustained():
+    eng, t = _engine([{
+        "name": "r", "action": "overprovision", "for_s": 5.0,
+        "trigger": {"metric": "rounds.straggler_rate", "op": ">",
+                    "threshold": 0.2},
+    }])
+    assert eng.evaluate({"rounds.straggler_rate": 0.3}) == []
+    t[0] = 2.0  # breach clears mid-pending: back to idle
+    assert eng.evaluate({"rounds.straggler_rate": 0.0}) == []
+    t[0] = 3.0
+    assert eng.evaluate({"rounds.straggler_rate": 0.3}) == []
+    t[0] = 9.0  # sustained past for_s from the NEW pending start
+    assert [e["event"] for e in eng.evaluate(
+        {"rounds.straggler_rate": 0.3})] == ["entered"]
+
+
+def test_unresolvable_metric_holds_state_with_skip_reason():
+    eng, _ = _engine([{
+        "name": "r", "action": "fedbuff_fallback",
+        "trigger": {"metric": "fleet.churn_frac", "op": ">",
+                    "threshold": 0.34},
+    }])
+    assert eng.evaluate({}) == []
+    snap = eng.status_snapshot()["rules"][0]
+    assert snap["state"] == "idle"
+    assert snap["skip_reason"]
+
+
+def test_events_logged_and_metrics_counted(tmp_path):
+    metrics = Metrics()
+    eng, t = _engine([{
+        "name": "r", "action": "bias_cohort", "cooldown_s": 0.0,
+        "trigger": {"alert": "straggler_rate"},
+    }], tmp_path=tmp_path, metrics=metrics)
+    eng.evaluate({}, firing=["straggler_rate"])
+    eng.record_actuation("r")
+    t[0] = 1.0
+    eng.evaluate({}, firing=[])
+    events, n_torn = read_runbooks_jsonl(str(tmp_path / "runbooks.jsonl"))
+    assert n_torn == 0
+    assert [e["event"] for e in events] == ["entered", "exited"]
+    assert events[0]["action"] == "bias_cohort"
+    assert events[0]["trigger"] == "alert:straggler_rate"
+    counters = metrics.snapshot()["counters"]
+    assert counters["runbooks_entered_total"] == 1
+    assert counters["runbooks_exited_total"] == 1
+    assert counters["runbooks_actuations_total"] == 1
+    snap = eng.status_snapshot()
+    assert snap["summary"]["actuations"] == 1
+    assert snap["rules"][0]["recent_transitions"] == ["entered", "exited"]
+
+
+# ----------------------------------------------------------------------
+# the per-round deadline override (adaptive_deadline's actuation site)
+
+
+def test_round_deadline_override_is_per_round():
+    clock = [0.0]
+    rm = RoundManager(name="x", round_timeout=10.0, clock=lambda: clock[0])
+    rm.start_round(n_epoch=1)
+    rm.set_deadline(2.0)
+    assert rm.effective_timeout == 2.0
+    clock[0] = 3.0
+    assert rm.is_expired
+    rm.end_round()
+    # the override dies with its round: the next one is back on the
+    # static timeout until (and unless) the actuation is re-applied
+    rm.start_round(n_epoch=1)
+    assert rm.effective_timeout == 10.0
+    rm.abort_round()
+    # no-op outside a round
+    rm.set_deadline(1.0)
+    assert rm.deadline_override is None
+
+
+# ----------------------------------------------------------------------
+# SLO namespaces: fairness shares + runbook lifecycle metrics
+
+
+def _health(clients):
+    return {"clients": clients}
+
+
+def test_fairness_balanced_fleet_equal_shares():
+    m = derive_fairness_metrics(_health({
+        "a": {"status": "healthy", "reported": 10},
+        "b": {"status": "healthy", "reported": 10},
+        "c": {"status": "slow", "reported": 10},
+        "d": {"status": "slow", "reported": 10},
+    }))
+    assert m["fairness:share:healthy"] == pytest.approx(0.5)
+    assert m["fairness:share:slow"] == pytest.approx(0.5)
+    assert m["fairness:share_per_client:slow"] == pytest.approx(0.25)
+    # proportional participation: floor ratio is exactly 1
+    assert m["fairness:participation_floor"] == pytest.approx(1.0)
+
+
+def test_fairness_biased_selection_shifts_shares_not_to_zero():
+    m = derive_fairness_metrics(_health({
+        "a": {"status": "healthy", "reported": 18},
+        "b": {"status": "healthy", "reported": 18},
+        "c": {"status": "slow", "reported": 6},
+        "d": {"status": "slow", "reported": 6},
+    }))
+    assert m["fairness:share:healthy"] == pytest.approx(0.75)
+    assert m["fairness:share:slow"] == pytest.approx(0.25)
+    # the floor quantifies the starvation margin: slow gets half its
+    # proportional share here
+    assert m["fairness:participation_floor"] == pytest.approx(0.5)
+
+
+def test_fairness_excludes_inactive_and_fails_loud_when_unmeasured():
+    m = derive_fairness_metrics(_health({
+        "a": {"status": "healthy", "reported": 10},
+        "gone": {"status": "inactive", "reported": 50},
+    }))
+    assert m["fairness:share:healthy"] == pytest.approx(1.0)
+    assert "fairness:share:inactive" not in m
+    assert "fairness:clients:inactive" not in m
+    # no reports at all -> no fairness metrics, and the namespace is
+    # NOT absence-is-zero: an asserted floor resolves missing
+    empty = derive_fairness_metrics(_health({}))
+    assert empty == {}
+    assert resolve_metric(empty, "fairness:participation_floor") is None
+
+
+def test_runbook_metrics_from_events_and_round_records():
+    events = [
+        {"event": "entered", "rule": "bias_stragglers"},
+        {"event": "exited", "rule": "bias_stragglers"},
+        {"event": "entered", "rule": "bias_stragglers"},
+        {"event": "entered", "rule": "fedbuff_on_churn"},
+    ]
+    records = [
+        {"round": "u1", "actuations": [
+            {"action": "bias_cohort", "rule": "bias_stragglers"},
+            {"action": "overprovision", "rule": "over"},
+        ]},
+        {"round": "u2", "actuations": [
+            {"action": "bias_cohort", "rule": "bias_stragglers"},
+        ]},
+        {"round": "u3"},
+    ]
+    m = derive_runbook_metrics(events, records)
+    assert m["runbook:entered:bias_stragglers"] == 2.0
+    assert m["runbook:exited:bias_stragglers"] == 1.0
+    assert m["runbook:entered_total"] == 3.0
+    assert m["runbook:exited_total"] == 1.0
+    assert m["runbook:actuated_rounds:bias_cohort"] == 2.0
+    assert m["runbook:actuated_rounds:overprovision"] == 1.0
+    assert m["runbook:actuations_total"] == 3.0
+    # absence-is-zero, like counters: a quiet run asserts == 0
+    assert resolve_metric({}, "runbook:entered_total") == 0.0
