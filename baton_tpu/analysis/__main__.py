@@ -97,6 +97,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         metavar="FILE",
         help="additionally write the JSON report to FILE (CI artifact)",
     )
+    parser.add_argument(
+        "--sarif",
+        metavar="FILE",
+        help="additionally write a SARIF 2.1.0 report to FILE",
+    )
+    parser.add_argument(
+        "--cache",
+        metavar="FILE",
+        nargs="?",
+        const=".batonlint_cache.json",
+        default=None,
+        help=(
+            "incremental summary cache keyed by file content hash "
+            "(default file when given bare: .batonlint_cache.json); "
+            "hit/miss counts appear in the JSON report"
+        ),
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -116,7 +133,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     try:
         report = run_paths(args.paths, rules=args.select,
-                           only_paths=only_paths)
+                           only_paths=only_paths,
+                           cache_path=args.cache)
     except KeyError as exc:
         print(f"batonlint: {exc.args[0]}", file=sys.stderr)
         return 2
@@ -128,6 +146,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         except OSError as exc:
             print(f"batonlint: cannot write {args.json_out}: {exc}",
+                  file=sys.stderr)
+            return 2
+
+    if args.sarif:
+        from baton_tpu.analysis.sarif import format_sarif
+
+        try:
+            pathlib.Path(args.sarif).write_text(
+                format_sarif(report) + "\n", encoding="utf-8"
+            )
+        except OSError as exc:
+            print(f"batonlint: cannot write {args.sarif}: {exc}",
                   file=sys.stderr)
             return 2
 

@@ -1,15 +1,20 @@
 """Static call graph over a batonlint :class:`~.project.Project`.
 
-Edges are the calls :meth:`Project.resolve_call` can pin down
-statically — same-module helpers, ``self.method``, imported symbols,
-and ``alias.func`` through an imported module.  Each edge keeps its
-call-site node so downstream rules (lock-order, staleness) can report
-the path a hazard travels, not just its endpoints.
+Edges are the calls :meth:`Project.resolve_call_multi` can pin down
+statically — same-module helpers, ``self.method`` (resolved through
+the class hierarchy: nearest inherited definition PLUS every known
+subclass override, so a lock acquired in an overriding method is
+visible to callers of the base method), ``super()`` chains, imported
+symbols, and ``alias.func`` through an imported module.  Each edge
+keeps its call-site node so downstream rules (lock-order, staleness)
+can report the path a hazard travels, not just its endpoints; a call
+site with several dispatch candidates contributes one edge per
+candidate.
 
-The graph is intentionally an over-approximation in neither direction:
-unresolvable calls (dynamic dispatch, HOFs, inheritance) are simply
-absent, so rules built on it UNDER-report across those boundaries and
-say so in their docs rather than guessing.
+Calls the resolver cannot pin down (``getattr``, HOFs, calls through
+arbitrary objects) are simply absent, so rules built on the graph
+UNDER-report across those boundaries and say so in their docs rather
+than guessing.
 """
 
 from __future__ import annotations
@@ -24,11 +29,28 @@ from baton_tpu.analysis.project import FunctionInfo, Project
 __all__ = ["CallEdge", "CallGraph"]
 
 
+def _is_self_call(call: ast.Call) -> bool:
+    """``self.m()`` / ``cls.m()`` / ``super().m()`` — calls whose
+    receiver is the caller's own instance, so the callee's ``self.*``
+    effects land on the caller's state."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if isinstance(func.value, ast.Name) and func.value.id in ("self", "cls"):
+        return True
+    return (
+        isinstance(func.value, ast.Call)
+        and isinstance(func.value.func, ast.Name)
+        and func.value.func.id == "super"
+    )
+
+
 @dataclasses.dataclass
 class CallEdge:
     caller: FunctionInfo
     callee: FunctionInfo
     node: ast.Call                # the call site, in caller's module
+    via_self: bool = False        # receiver is the caller's own instance
 
     @property
     def line(self) -> int:
@@ -49,11 +71,13 @@ class CallGraph:
             for node in au.walk_shallow(fn.node):
                 if not isinstance(node, ast.Call):
                     continue
-                callee = project.resolve_call(
+                for callee in project.resolve_call_multi(
                     fn.module, fn.class_name, node
-                )
-                if callee is not None and callee.key != fn.key:
-                    out.append(CallEdge(fn, callee, node))
+                ):
+                    if callee.key != fn.key:
+                        out.append(
+                            CallEdge(fn, callee, node, _is_self_call(node))
+                        )
             self.edges[fn.key] = out
 
     def callees(self, key: str) -> List[CallEdge]:

@@ -15,9 +15,11 @@ from baton_tpu.analysis.checkers import (  # noqa: F401
     donation,
     exemplars,
     locks,
+    races,
     runbooks,
     spans,
     staleness,
+    suppressions,
     tracer,
     wirecap,
 )

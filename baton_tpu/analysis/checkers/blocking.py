@@ -5,43 +5,44 @@ blob Range GET, and upload ack at once (the exact failure PR 3's ingest
 pipeline exists to prevent). This rule flags calls that synchronously
 block — ``time.sleep``, ``pickle.loads``, ``zlib.*``, file I/O,
 ``.block_until_ready()``, ``jax.device_get`` — when they execute ON the
-loop: directly in an ``async def`` body, or inside a plain helper the
-async function calls (resolved transitively through same-module
-``self.helper()`` / ``helper()`` calls).
+loop: directly in an ``async def`` body, or inside any plain (sync)
+helper the async function reaches through the project call graph.
+
+Reachability comes from the bottom-up fixpoint summaries
+(:mod:`baton_tpu.analysis.summaries`): helper chains resolve across
+modules and through class-hierarchy dispatch (``self.helper()`` hits
+every known override), to any depth, and each finding carries the
+witness chain.  The finding points at the blocking call itself — which
+may be in a NON-server module when a server handler reaches into a
+shared helper — and is additionally suppressible at the async caller's
+call site when both live in the same file.
 
 Work routed off the loop is not flagged: nested ``def``/``lambda``
 bodies are skipped (they are the closures handed to
-``asyncio.to_thread`` / ``run_in_executor`` / the ingest pool), and the
-routing calls themselves are awaits, not blocking calls.
+``asyncio.to_thread`` / ``run_in_executor`` / the ingest pool), the
+routing calls themselves are awaits, and a sync frame merely *calling*
+an ``async def`` (no await possible) only builds a coroutine, so
+nothing behind it is considered reached.
+
+The blocked-primitive tables live in
+:mod:`baton_tpu.analysis.summaries` (the summary extraction records
+the sites); this module owns the reachability policy and reporting.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Iterable, List
 
-from baton_tpu.analysis import _astutil as au
-from baton_tpu.analysis.engine import Checker, CheckContext, Finding, register
-
-# fully-resolved dotted names that block the loop
-BLOCKED_DOTTED = {
-    "time.sleep": "time.sleep() blocks the event loop; await asyncio.sleep",
-    "pickle.load": "pickle.load() is blocking CPU/IO work",
-    "pickle.loads": "pickle.loads() is blocking CPU work",
-    "jax.device_get": "jax.device_get() blocks on device transfer",
-}
-# any call into these modules blocks (compression is pure CPU burn)
-BLOCKED_MODULE_PREFIXES = ("zlib.",)
-# bare-name builtins
-BLOCKED_NAMES = {"open": "open() is blocking file I/O"}
-# method attributes that block regardless of receiver type
-BLOCKED_METHODS = {
-    "block_until_ready": ".block_until_ready() blocks on device compute",
-    "read_text": "file I/O (.read_text) blocks the event loop",
-    "write_text": "file I/O (.write_text) blocks the event loop",
-    "read_bytes": "file I/O (.read_bytes) blocks the event loop",
-    "write_bytes": "file I/O (.write_bytes) blocks the event loop",
-}
+from baton_tpu.analysis.engine import Finding, ProjectChecker, register
+from baton_tpu.analysis.summaries import (  # noqa: F401  (re-exported)
+    BLOCKED_DOTTED,
+    BLOCKED_METHODS,
+    BLOCKED_MODULE_PREFIXES,
+    BLOCKED_NAMES,
+    blocked_reason,
+    get_summaries,
+)
 
 _ROUTE_HINT = (
     "; route it through asyncio.to_thread / run_in_executor / the "
@@ -49,100 +50,53 @@ _ROUTE_HINT = (
 )
 
 
-def _blocked_reason(call: ast.Call) -> Optional[Tuple[str, str]]:
-    """``(display_name, reason)`` when the call is a blocking
-    primitive, else None."""
-    name = au.call_name(call)
-    if name is not None:
-        if name in BLOCKED_DOTTED:
-            return name, BLOCKED_DOTTED[name]
-        for prefix in BLOCKED_MODULE_PREFIXES:
-            if name.startswith(prefix):
-                return name, f"{prefix}* compression is blocking CPU work"
-        if name in BLOCKED_NAMES:
-            return name, BLOCKED_NAMES[name]
-    func = call.func
-    if isinstance(func, ast.Attribute) and func.attr in BLOCKED_METHODS:
-        display = name if name is not None else f"<expr>.{func.attr}"
-        return display, BLOCKED_METHODS[func.attr]
-    return None
-
-
 @register
-class BlockingCallChecker(Checker):
+class BlockingCallChecker(ProjectChecker):
     rule = "BTL001"
     title = "blocking call reachable from async def in baton_tpu/server/"
 
-    def applies_to(self, ctx: CheckContext) -> bool:
-        return "server" in ctx.parts
-
-    def check(self, ctx: CheckContext) -> Iterable[Finding]:
-        sync_index = au.sync_function_index(ctx.tree)
+    def check_project(self, project) -> Iterable[Finding]:
         findings: List[Finding] = []
-        # memoized per-helper scan: [(call_node, display, reason)]
-        helper_hits: Dict[str, list] = {}
-
-        def scan_direct(node) -> list:
-            hits = []
-            for child in au.walk_shallow(node):
-                if isinstance(child, ast.Call):
-                    blocked = _blocked_reason(child)
-                    if blocked is not None:
-                        hits.append((child, *blocked))
-            return hits
-
-        def helper_chain_hits(qual: str, visited: frozenset) -> list:
-            """Blocking hits in ``qual`` and the sync helpers it calls."""
-            if qual in visited:
-                return []
-            if qual in helper_hits:
-                return helper_hits[qual]
-            node = sync_index.get(qual)
-            if node is None:
-                return []
-            hits = list(scan_direct(node))
-            cls = qual.rsplit(".", 1)[0] if "." in qual else None
-            for child in au.walk_shallow(node):
-                if isinstance(child, ast.Call):
-                    callee = au.resolve_local_call(child, cls)
-                    if callee is not None and callee != qual:
-                        for hit in helper_chain_hits(
-                            callee, visited | {qual}
-                        ):
-                            hits.append(hit)
-            helper_hits[qual] = hits
-            return hits
-
-        for qual, cls, node in au.iter_function_defs(ctx.tree):
-            if not isinstance(node, ast.AsyncFunctionDef):
+        summaries = get_summaries(project)
+        for fn in project.functions():
+            if "server" not in fn.module.parts:
                 continue
-            for call, display, reason in scan_direct(node):
-                findings.append(
-                    Finding(
-                        self.rule, ctx.path, call.lineno, call.col_offset,
-                        f"{reason} (in `async def {node.name}`)"
-                        + _ROUTE_HINT,
-                    )
-                )
-            # transitive: sync helpers invoked from the async body run
-            # on the loop too — the regression vector a direct-only
-            # check misses (report_update -> _persist_pending -> disk)
-            for child in au.walk_shallow(node):
-                if not isinstance(child, ast.Call):
-                    continue
-                callee = au.resolve_local_call(child, cls)
-                if callee is None or callee not in sync_index:
-                    continue
-                for call, display, reason in helper_chain_hits(
-                    callee, frozenset()
-                ):
+            if not isinstance(fn.node, ast.AsyncFunctionDef):
+                continue
+            lf = summaries.locals.get(fn.key)
+            if lf is not None:
+                for line, col, _display, reason in lf.blocking:
                     findings.append(
                         Finding(
-                            self.rule, ctx.path,
-                            call.lineno, call.col_offset,
+                            self.rule, fn.module.path, line, col,
+                            f"{reason} (in `async def {fn.node.name}`)"
+                            + _ROUTE_HINT,
+                        )
+                    )
+            # transitive: sync helpers invoked from the async body run
+            # on the loop too — the regression vector a direct-only
+            # check misses (report_update -> _persist_pending -> disk).
+            # Only SYNC callees: an async callee is an async def in its
+            # own right and gets its own direct findings.
+            for edge in summaries.graph.callees(fn.key):
+                callee = summaries.get(edge.callee.key)
+                if callee is None or callee.is_async:
+                    continue
+                for (path, line, col), (
+                    _display, reason, chain,
+                ) in sorted(callee.blocking.items()):
+                    full_chain = (edge.callee.qualname,) + chain
+                    via = " -> ".join(f"{q}()" for q in full_chain)
+                    also = (
+                        (edge.node.lineno,)
+                        if path == fn.module.path else ()
+                    )
+                    findings.append(
+                        Finding(
+                            self.rule, path, line, col,
                             f"{reason} (reached from `async def "
-                            f"{node.name}` via {callee}())" + _ROUTE_HINT,
-                            also_lines=(child.lineno,),
+                            f"{fn.node.name}` via {via})" + _ROUTE_HINT,
+                            also_lines=also,
                         )
                     )
         return findings

@@ -9,8 +9,12 @@ Two sub-rules:
 
 * an ``await`` of a network/queue primitive (aiohttp verbs,
   ``resp.json()``/``.read()``/``.text()``, queue ``get``/``put``/
-  ``join``, ``asyncio.sleep``) lexically inside ``async with <lock>:``
-  is flagged at the await, suppressible at either the await line or the
+  ``join``, ``asyncio.sleep``) inside ``async with <lock>:`` is flagged
+  at the await — lexically, or when the lock is held across an ``await``
+  of a project coroutine whose bottom-up fixpoint summary
+  (:mod:`~baton_tpu.analysis.summaries`) performs a network await at any
+  depth (the finding then names the remote site and the witness chain).
+  Either way it is suppressible at the await/call line or at the
   ``async with`` header (one allow covers a deliberately-held block);
 * lock-acquisition ORDER is a whole-program directed graph: acquiring
   B while holding A — directly, or anywhere down the static call graph
@@ -23,7 +27,9 @@ A "lock" is any ``async with`` context whose name ends with ``lock``
 or ``mutex`` (``self._register_lock``, ``state_lock``, ...) — naming
 convention as lint contract, same spirit as the counter registry.
 Identities unify where references do: ``self._x_lock`` is
-``Class._x_lock`` from any method, a module-global is
+``RootClass._x_lock`` — the ROOT ancestor that introduces the
+attribute, so an acquisition in an overriding subclass method unifies
+with the base class's (class-hierarchy analysis); a module-global is
 ``pkg.mod.x_lock`` from its home module or through any import alias.
 Locks reached through other objects' attributes stay module-local
 (no type inference), so cycles through those are still unseen.
@@ -36,53 +42,29 @@ import dataclasses
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from baton_tpu.analysis import _astutil as au
-from baton_tpu.analysis.callgraph import CallGraph
 from baton_tpu.analysis.engine import Finding, ProjectChecker, register
 from baton_tpu.analysis.project import FunctionInfo, ModuleInfo, Project
-
-# attribute names that mean "this await leaves the process" (HTTP verb,
-# body read, queue hand-off) — receiver-agnostic by design: sessions,
-# responses and queues go by many names
-NETWORK_ATTRS = {
-    "get", "post", "put", "patch", "delete", "head", "request",
-    "read", "text", "json", "recv", "receive", "send", "send_json",
-    "fetch", "connect", "join", "drain",
-}
-NETWORK_DOTTED = {"asyncio.sleep"}
+from baton_tpu.analysis.summaries import (  # noqa: F401  (re-exported)
+    NETWORK_ATTRS,
+    NETWORK_DOTTED,
+    get_summaries,
+    is_network_call,
+    lock_identity,
+)
 
 
 def _lock_identity(
-    expr: ast.AST, class_name: Optional[str], mod: ModuleInfo
+    expr: ast.AST,
+    class_name: Optional[str],
+    mod: ModuleInfo,
+    project: Optional[Project] = None,
 ) -> Optional[str]:
     """Normalized project-wide lock identity for an ``async with``
-    context expr, or None when the context is not a lock."""
-    name = au.dotted_name(expr)
-    if name is None:
-        return None
-    leaf = name.rsplit(".", 1)[-1].lower()
-    if not (leaf.endswith("lock") or leaf.endswith("mutex")):
-        return None
-    root, _, rest = name.partition(".")
-    if root in ("self", "cls") and rest and class_name is not None:
-        return f"{class_name}.{rest}"
-    if rest:
-        target = mod.imports.get(root)
-        if target is not None:
-            # module-global lock referenced through an import alias:
-            # unify with its home-module bare name
-            return f"{target}.{rest}"
-        return f"{mod.name}:{name}"  # some other object's attribute
-    return f"{mod.name}.{name}"
-
-
-def _is_network_call(call: ast.Call) -> bool:
-    dotted = au.call_name(call)
-    if dotted in NETWORK_DOTTED:
-        return True
-    return (
-        isinstance(call.func, ast.Attribute)
-        and call.func.attr in NETWORK_ATTRS
-    )
+    context expr, or None when the context is not a lock.  With a
+    project, ``self._x_lock`` normalizes to the ROOT-ancestor class that
+    first declares the attribute, so a lock acquired in an overriding
+    subclass method unifies with the base class's acquisitions."""
+    return lock_identity(expr, class_name, mod, project=project)
 
 
 @dataclasses.dataclass
@@ -117,19 +99,39 @@ class LockDisciplineChecker(ProjectChecker):
 
     def check_project(self, project: Project) -> Iterable[Finding]:
         findings: List[Finding] = []
-        graph = CallGraph(project)
+        summaries = get_summaries(project)
+        graph = summaries.graph
         # per function: lock acquisitions and the calls made under lock
         acquires: Dict[str, List[_Acquisition]] = {}
         held_calls: Dict[str, List[Tuple[Tuple[str, ...], ast.Call]]] = {}
+        awaited: Dict[str, set] = {}  # ids of Call nodes directly awaited
         for fn in project.functions():
             acqs: List[_Acquisition] = []
             calls: List[Tuple[Tuple[str, ...], ast.Call]] = []
+            aw: set = set()
             self._collect(
-                fn.node.body, fn, acqs, calls, (), findings
+                fn.node.body, fn, project, acqs, calls, aw, (), findings
             )
             acquires[fn.key] = acqs
             held_calls[fn.key] = calls
+            awaited[fn.key] = aw
 
+        # multi-hop: awaiting a project coroutine under a lock executes
+        # every network await in that coroutine's fixpoint summary while
+        # the lock is held — same stall, one call frame removed.
+        for fn in project.functions():
+            for held, call in held_calls[fn.key]:
+                if id(call) not in awaited[fn.key]:
+                    continue  # bare coroutine creation: nothing runs yet
+                for edge in graph.callees(fn.key):
+                    if edge.node is not call:
+                        continue
+                    summ = summaries.get(edge.callee.key)
+                    if summ is None or not summ.is_async:
+                        continue
+                    self._flag_summary_awaits(
+                        fn, call, held, edge, summ, findings
+                    )
         # locks each function may acquire transitively, with the call
         # chain and site that witnesses the acquisition
         trans_memo: Dict[str, Dict[str, Tuple[str, int, Tuple[str, ...]]]] = {}
@@ -168,25 +170,26 @@ class LockDisciplineChecker(ProjectChecker):
                             ),
                         )
             for held, call in held_calls[fn.key]:
-                callee = next(
-                    (e for e in graph.callees(fn.key) if e.node is call),
-                    None,
-                )
-                if callee is None:
-                    continue
-                for lock, (_p, _l, chain) in trans(
-                    callee.callee.key, frozenset({fn.key})
-                ).items():
-                    for outer in held:
-                        if outer != lock:
-                            order.setdefault(
-                                (outer, lock),
-                                _Witness(
-                                    fn.module.path, call.lineno,
-                                    call.col_offset,
-                                    (fn.qualname,) + chain,
-                                ),
-                            )
+                # ALL dispatch candidates for this call node: through
+                # the class hierarchy a self.method() may land in any
+                # subclass override, and a lock acquired only in the
+                # override must still order after the held ones
+                for edge in graph.callees(fn.key):
+                    if edge.node is not call:
+                        continue
+                    for lock, (_p, _l, chain) in trans(
+                        edge.callee.key, frozenset({fn.key})
+                    ).items():
+                        for outer in held:
+                            if outer != lock:
+                                order.setdefault(
+                                    (outer, lock),
+                                    _Witness(
+                                        fn.module.path, call.lineno,
+                                        call.col_offset,
+                                        (fn.qualname,) + chain,
+                                    ),
+                                )
 
         findings.extend(self._report_cycles(order))
         return findings
@@ -267,16 +270,19 @@ class LockDisciplineChecker(ProjectChecker):
         self,
         stmts,
         fn: FunctionInfo,
+        project: Project,
         acqs: List[_Acquisition],
         calls: List[Tuple[Tuple[str, ...], ast.Call]],
+        awaited: set,
         held: Tuple[str, ...],
         findings: List[Finding],
     ) -> None:
         for stmt in stmts:
-            self._visit(stmt, fn, acqs, calls, held, findings)
+            self._visit(stmt, fn, project, acqs, calls, awaited,
+                        held, findings)
 
     def _visit(
-        self, node, fn, acqs, calls, held, findings
+        self, node, fn, project, acqs, calls, awaited, held, findings
     ) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda)):
@@ -288,30 +294,54 @@ class LockDisciplineChecker(ProjectChecker):
             ]
             for item in node.items:
                 expr = item.context_expr
-                lock = _lock_identity(expr, fn.class_name, fn.module)
+                lock = _lock_identity(
+                    expr, fn.class_name, fn.module, project
+                )
                 if lock is not None:
                     acqs.append(_Acquisition(lock, node, new_held))
                     new_held = new_held + (lock,)
                 elif (
                     held
                     and isinstance(expr, ast.Call)
-                    and _is_network_call(expr)
+                    and is_network_call(expr)
                 ):
                     # async with session.get(...) under a lock is the
                     # same hazard as awaiting it
                     self._flag_network(expr, held, node, fn, findings)
             for child in ast.iter_child_nodes(node):
                 if child not in header:
-                    self._visit(child, fn, acqs, calls, new_held, findings)
+                    self._visit(child, fn, project, acqs, calls, awaited,
+                                new_held, findings)
             return
-        if held and isinstance(node, ast.Await):
-            value = node.value
-            if isinstance(value, ast.Call) and _is_network_call(value):
-                self._flag_network(value, held, None, fn, findings)
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            awaited.add(id(node.value))
+            if held and is_network_call(node.value):
+                self._flag_network(node.value, held, None, fn, findings)
         if held and isinstance(node, ast.Call):
             calls.append((held, node))
         for child in ast.iter_child_nodes(node):
-            self._visit(child, fn, acqs, calls, held, findings)
+            self._visit(child, fn, project, acqs, calls, awaited,
+                        held, findings)
+
+    def _flag_summary_awaits(
+        self, fn, call, held, edge, summ, findings
+    ) -> None:
+        for (path, line, _c), (display, chain) in sorted(
+            summ.network_awaits.items()
+        ):
+            full = (edge.callee.qualname,) + chain
+            via = " -> ".join(f"{q}()" for q in full)
+            findings.append(
+                Finding(
+                    self.rule, fn.module.path,
+                    call.lineno, call.col_offset,
+                    f"await of network/queue primitive `{display}` "
+                    f"(at {path}:{line}, reached via {via}) while "
+                    f"holding lock `{held[-1]}` stalls every waiter "
+                    f"for a peer round-trip",
+                    also_lines=self._enclosing_lock_lines(fn, call),
+                )
+            )
 
     def _flag_network(self, call, held, _hdr, fn, findings) -> None:
         lock = held[-1]

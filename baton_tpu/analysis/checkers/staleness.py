@@ -24,19 +24,33 @@ What counts as a *snapshot source* (assignment RHS, walrus included):
 * a same-class/same-module helper call whose return value is, one hop
   down, such a read (``self._secure_state(name)``).
 
-A use of the snapshot *after* a statement containing an ``await`` is
-flagged unless a *revalidation* ran in between: an ``is``/``is not``
-identity comparison of the snapshot against anything but ``None``, or
-a fresh re-read into the same name.  A mutation committed into the
-snapshot in the SAME statement as the await (``st[...].update(await
-...)`` — the pre-fix ``round_start`` shape) is flagged directly: the
-receiver was read before the suspension, the write lands after it.
+A use of the snapshot *after* a suspension point (an ``await``
+expression, or entry into an ``async with`` / ``async for`` header) is
+flagged unless a *revalidation* ran in between:
 
-Scope: ``async def``s under ``server/`` only, and control flow is
-approximated by source order within the function (branch-insensitive)
-— a heuristic, so genuinely-safe hits (state protected by an
-in-progress guard, for instance) should carry a justified
-``# batonlint: allow[BTL003]``.
+* an ``is``/``is not`` identity comparison of the snapshot against
+  anything but ``None``, or a fresh re-read into the same name; or
+* **delegated revalidation** — passing the snapshot to a same-class/
+  same-module helper that itself compares that parameter (``is`` or
+  ``==``) against the snapshot's source attribute (the
+  compare-and-invalidate idiom: ``self._invalidate_credentials(cid)``).
+
+A mutation committed into the snapshot in the SAME statement as the
+await (``st[...].update(await ...)`` — the pre-fix ``round_start``
+shape) is flagged directly: the receiver was read before the
+suspension, the write lands after it.
+
+Control flow is **branch-sensitive**: ``if``/``elif``/``else`` arms
+are analyzed with forked snapshot states and merged afterwards, and an
+arm that *terminates* (ends in ``return``/``raise``/``continue``/
+``break``) does not leak its staleness into the fall-through path — so
+a guard like ``if cached: return await self._proxy(...)`` no longer
+poisons the straight-line code after it, and a re-check that returns
+on mismatch validates the surviving path.  Loops and ``try`` bodies
+are still visited sequentially (their effects union), so genuinely-
+safe hits there may need a justified ``# batonlint: allow[BTL003]``.
+
+Scope: ``async def``s under ``server/`` only.
 """
 
 from __future__ import annotations
@@ -48,6 +62,7 @@ from baton_tpu.analysis import _astutil as au
 from baton_tpu.analysis.engine import Checker, CheckContext, Finding, register
 
 _FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_TERMINATORS = (ast.Return, ast.Raise, ast.Continue, ast.Break)
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:
@@ -161,6 +176,60 @@ def _collect_helper_sources(
     return sources
 
 
+def _collect_revalidators(
+    tree: ast.Module,
+) -> Dict[Tuple[str, int], Set[str]]:
+    """``(helper_qualname, param_index) -> {snapshot sources}`` for
+    helpers that compare one of their parameters (``is``/``is not`` or
+    ``==``/``!=``) against a ``self.X`` read — the compare-and-
+    invalidate idiom.  A caller passing a snapshot of ``self.X`` into
+    such a parameter has delegated the freshness re-check."""
+    out: Dict[Tuple[str, int], Set[str]] = {}
+    for qual, cls, fn in au.iter_function_defs(tree):
+        params = [
+            a.arg
+            for a in (
+                list(getattr(fn.args, "posonlyargs", []))
+                + list(fn.args.args)
+            )
+        ]
+        if not params:
+            continue
+        index = {name: i for i, name in enumerate(params)}
+        for node in au.walk_shallow(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.Eq, ast.NotEq))
+                for op in node.ops
+            ):
+                continue
+            operands = [node.left] + list(node.comparators)
+            attrs = set()
+            for o in operands:
+                # a bare `self.X`, or the registry-read shape
+                # `self.X.get(...)` (comparing the snapshot against a
+                # FRESH read of the same registry)
+                a = _self_attr(o)
+                if a is None and isinstance(o, ast.Call):
+                    func = o.func
+                    if (
+                        isinstance(func, ast.Attribute)
+                        and func.attr == "get"
+                    ):
+                        a = _self_attr(func.value)
+                if a is not None:
+                    attrs.add(f"self.{a}")
+            if not attrs:
+                continue
+            for o in operands:
+                if isinstance(o, ast.Name) and o.id in index:
+                    out.setdefault(
+                        (qual, index[o.id]), set()
+                    ).update(attrs)
+    return out
+
+
 class _Tracked:
     __slots__ = ("source", "line", "pending_since", "dead")
 
@@ -169,6 +238,18 @@ class _Tracked:
         self.line = line              # snapshot line
         self.pending_since: Optional[int] = None  # line of staling await
         self.dead = False             # already reported / reassigned
+
+    def clone(self) -> "_Tracked":
+        tr = _Tracked(self.source, self.line)
+        tr.pending_since = self.pending_since
+        tr.dead = self.dead
+        return tr
+
+
+def _terminates(block: List[ast.stmt]) -> bool:
+    """The block can never fall through to the statement after its
+    ``if``: its last statement returns/raises/continues/breaks."""
+    return bool(block) and isinstance(block[-1], _TERMINATORS)
 
 
 @register
@@ -183,20 +264,20 @@ class StaleSnapshotChecker(Checker):
         findings: List[Finding] = []
         mutable_attrs = _collect_mutable_attrs(ctx.tree)
         helper_sources = _collect_helper_sources(ctx.tree, mutable_attrs)
+        revalidators = _collect_revalidators(ctx.tree)
         for qual, cls, fn in au.iter_function_defs(ctx.tree):
             if not isinstance(fn, ast.AsyncFunctionDef):
                 continue
             self._check_function(
                 fn, cls, mutable_attrs.get(cls, set()),
-                helper_sources, findings, ctx,
+                helper_sources, revalidators, findings, ctx,
             )
         return findings
 
     # ------------------------------------------------------------------
     def _check_function(
-        self, fn, cls, attrs, helper_sources, findings, ctx
+        self, fn, cls, attrs, helper_sources, revalidators, findings, ctx
     ) -> None:
-        tracked: Dict[str, _Tracked] = {}
 
         def flag(name: str, tr: _Tracked, node: ast.AST) -> None:
             tr.dead = True
@@ -280,6 +361,32 @@ class StaleSnapshotChecker(Checker):
                             out.add(o.id)
             return out
 
+        def delegated_revalidations(
+            nodes: List[ast.AST],
+        ) -> Dict[str, Set[str]]:
+            """``{name: {sources}}`` for snapshot names passed into a
+            helper parameter that the helper compares against that
+            source — the call IS the re-check."""
+            out: Dict[str, Set[str]] = {}
+            for e in nodes:
+                for n in walk_expr(e):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    qual = au.resolve_local_call(n, cls)
+                    if qual is None:
+                        continue
+                    # self.helper(a) binds a to the param AFTER self
+                    offset = (
+                        1 if isinstance(n.func, ast.Attribute) else 0
+                    )
+                    for i, arg in enumerate(n.args):
+                        if not isinstance(arg, ast.Name):
+                            continue
+                        sources = revalidators.get((qual, i + offset))
+                        if sources:
+                            out.setdefault(arg.id, set()).update(sources)
+            return out
+
         def compare_nodes(nodes: List[ast.AST]) -> List[ast.AST]:
             comps = []
             for e in nodes:
@@ -320,7 +427,7 @@ class StaleSnapshotChecker(Checker):
                 root = root.value
             return root.id if isinstance(root, ast.Name) else None
 
-        def same_stmt_commit(stmt) -> Optional[Tuple[str, ast.AST]]:
+        def same_stmt_commit(stmt, tracked) -> Optional[Tuple[str, ast.AST]]:
             """``st[...].xxx(await ...)`` / ``st[...] = await ...``:
             snapshot receiver mutated with an awaited value."""
             for e in exprs_of(stmt):
@@ -380,7 +487,34 @@ class StaleSnapshotChecker(Checker):
                     out.add(stmt.target.id)
             return out
 
-        def visit(stmts) -> None:
+        def merge(
+            arm_states: List[Tuple[Dict[str, _Tracked], bool]],
+        ) -> Dict[str, _Tracked]:
+            """Join the snapshot states of the arms that can fall
+            through; a terminating arm contributes nothing (its
+            staleness dies with it)."""
+            live = [st for st, ends in arm_states if not ends]
+            if not live:
+                return {}
+            names = set(live[0])
+            for st in live[1:]:
+                names &= set(st)
+            out: Dict[str, _Tracked] = {}
+            for name in names:
+                trs = [st[name] for st in live]
+                if len({tr.source for tr in trs}) != 1:
+                    continue
+                m = trs[0].clone()
+                for tr in trs[1:]:
+                    if tr.dead:
+                        m.dead = True
+                    if m.pending_since is None:
+                        m.pending_since = tr.pending_since
+                    m.line = min(m.line, tr.line)
+                out[name] = m
+            return out
+
+        def visit(stmts, tracked: Dict[str, _Tracked]) -> None:
             for stmt in stmts:
                 if isinstance(stmt, _FUNCS + (ast.ClassDef,)):
                     continue
@@ -388,51 +522,94 @@ class StaleSnapshotChecker(Checker):
 
                 # 1. stale uses (statement-order approximation: the
                 #    header of this statement evaluates before any
-                #    await IN it suspends, so check uses first)
+                #    await IN it suspends, so check uses first).  An
+                #    identity re-check in an `if` guard whose arm
+                #    terminates (`if self._round is not r: return ...`)
+                #    is the full fix idiom — the author installed the
+                #    protocol, so STOP tracking the snapshot; a bare
+                #    compare merely resets the pending await.
+                delegated = delegated_revalidations(header)
+                reval = revalidated_names(header)
+                guard_installed = isinstance(stmt, ast.If) and (
+                    _terminates(stmt.body) or _terminates(stmt.orelse)
+                )
+                validated: List[str] = []
                 for name, tr in list(tracked.items()):
+                    if name in reval and guard_installed:
+                        validated.append(name)
+                        continue
                     if tr.dead or tr.pending_since is None:
                         continue
-                    if revalidated_names(header) & {name}:
+                    if name in reval:
                         tr.pending_since = None
+                        continue
+                    if tr.source in delegated.get(name, ()):
+                        tr.pending_since = None  # helper does the check
                         continue
                     hits = uses_of(name, header)
                     if hits:
                         flag(name, tr, hits[0])
+                for name in validated:
+                    tracked.pop(name, None)
 
                 # 2. same-statement commit-through-await pattern
-                commit = same_stmt_commit(stmt)
+                commit = same_stmt_commit(stmt, tracked)
                 if commit is not None:
                     name, node = commit
                     tr = tracked[name]
                     if not tr.dead:
                         flag_same_stmt(name, tr, node)
 
-                # 3. an await in this statement stales every snapshot
+                # 3. a suspension in this statement stales every
+                #    snapshot: an await expression, or entering an
+                #    async-with/async-for header (their __aenter__ /
+                #    __anext__ suspend too)
                 aw = has_await(header)
-                if aw is not None:
+                line: Optional[int] = aw.lineno if aw is not None else None
+                if line is None and isinstance(
+                    stmt, (ast.AsyncWith, ast.AsyncFor)
+                ):
+                    line = stmt.lineno
+                if line is not None:
                     for tr in tracked.values():
                         if not tr.dead and tr.pending_since is None:
-                            tr.pending_since = aw.lineno
+                            tr.pending_since = line
 
                 # 4. (re)bindings: fresh snapshots reset, anything else
                 #    stops tracking the name
                 fresh = snapshot_bindings(stmt)
-                for name, src, line in fresh:
-                    tracked[name] = _Tracked(src, line)
+                for name, src, sline in fresh:
+                    tracked[name] = _Tracked(src, sline)
                 for name in assigned_names(stmt) - {
                     n for n, _s, _l in fresh
                 }:
                     tracked.pop(name, None)
 
-                # recurse into child statement blocks, source order
+                # 5. child blocks: `if` arms fork and merge (branch-
+                #    sensitive; a terminating arm's staleness never
+                #    reaches the fall-through), everything else visits
+                #    sequentially (effects union — conservative)
+                if isinstance(stmt, ast.If):
+                    arms: List[Tuple[Dict[str, _Tracked], bool]] = []
+                    for block in (stmt.body, stmt.orelse):
+                        st = {
+                            name: tr.clone()
+                            for name, tr in tracked.items()
+                        }
+                        visit(block, st)
+                        arms.append((st, _terminates(block)))
+                    merged = merge(arms)
+                    tracked.clear()
+                    tracked.update(merged)
+                    continue
                 for block in (
                     getattr(stmt, "body", None),
                     getattr(stmt, "orelse", None),
                     getattr(stmt, "finalbody", None),
                 ):
                     if isinstance(block, list):
-                        visit(block)
+                        visit(block, tracked)
                 for handler in getattr(stmt, "handlers", []) or []:
-                    visit(handler.body)
+                    visit(handler.body, tracked)
 
-        visit(fn.body)
+        visit(fn.body, {})

@@ -14,7 +14,17 @@ traced too):
 * ``np.asarray`` / ``np.array`` / ``np.copy`` on parameter-derived
   values — silently materializes the tracer on host;
 * module-state mutation (``global`` declarations, writes through
-  module-level names) — trace-time side effects that do not replay.
+  module-level names) — trace-time side effects that do not replay;
+* a call into any project function — same module, another module, or a
+  ``self.method()`` through class-hierarchy dispatch — whose bottom-up
+  fixpoint summary (:mod:`~baton_tpu.analysis.summaries`) contains one
+  of the hazards above, at any depth.  ``print`` in a helper fires
+  unconditionally (the helper's body is traced too); casts /
+  materializers / ``.item()`` in a helper fire only when the call
+  passes a traced argument, since they concretize the *caller's*
+  tracer through the parameter.  The finding lands at the call site in
+  the traced function and names the hazard's true location and witness
+  chain.
 
 A function counts as traced when it is decorated with
 ``jax.jit`` / ``jit`` / ``pmap`` / ``shard_map`` (bare or wrapped in
@@ -38,10 +48,11 @@ only attributes explicitly written with traced values are.
 from __future__ import annotations
 
 import ast
-from typing import Callable, Iterable, List, Optional, Set
+from typing import Iterable, List, Optional, Set
 
 from baton_tpu.analysis import _astutil as au
-from baton_tpu.analysis.engine import Checker, CheckContext, Finding, register
+from baton_tpu.analysis.engine import Finding, ProjectChecker, register
+from baton_tpu.analysis.summaries import get_summaries
 
 # dotted-name leaves that mark a JAX tracing transform
 _TRANSFORMS = {"jit", "pmap", "shard_map", "vmap_of_jit"}
@@ -50,122 +61,7 @@ _NP_MATERIALIZERS = {"asarray", "array", "copy"}
 
 _CASTS = {"float", "int", "bool", "complex"}
 
-# attribute reads that are static (concrete) even on a tracer
-_STATIC_ATTRS = {"shape", "dtype", "ndim"}
-
-# container mutators whose tainted argument taints the receiver
-_CONTAINER_MUTATORS = {
-    "append", "extend", "insert", "add", "update", "setdefault",
-}
-
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-
-
-def _make_taint_oracle(tainted: Set[str]) -> Callable[[ast.AST], bool]:
-    """Predicate: does this expression produce a traced value, given
-    the current taint set (bare names and dotted ``self.attr`` paths)?"""
-
-    def expr_tainted(expr: ast.AST) -> bool:
-        if isinstance(expr, ast.Name):
-            return expr.id in tainted
-        if isinstance(expr, ast.Attribute):
-            if expr.attr in _STATIC_ATTRS:
-                return False
-            dotted = au.dotted_name(expr)
-            if dotted is not None and dotted in tainted:
-                return True
-            return expr_tainted(expr.value)
-        if isinstance(expr, _FUNC_NODES):
-            return False
-        if isinstance(expr, ast.Call):
-            if expr_tainted(expr.func):
-                return True
-            return any(expr_tainted(a) for a in expr.args) or any(
-                expr_tainted(k.value) for k in expr.keywords
-            )
-        return any(
-            expr_tainted(child)
-            for child in ast.iter_child_nodes(expr)
-            if isinstance(child, ast.expr)
-        )
-
-    return expr_tainted
-
-
-def _taint_target(target: ast.AST, add: Callable[[str], None]) -> None:
-    """Record an assignment target as tainted: names directly, dotted
-    ``self.x`` paths by path, container element writes by container."""
-    if isinstance(target, ast.Name):
-        add(target.id)
-    elif isinstance(target, (ast.Tuple, ast.List)):
-        for elt in target.elts:
-            _taint_target(elt, add)
-    elif isinstance(target, ast.Starred):
-        _taint_target(target.value, add)
-    elif isinstance(target, ast.Attribute):
-        dotted = au.dotted_name(target)
-        if dotted is not None:
-            add(dotted)
-        else:
-            _taint_target(target.value, add)
-    elif isinstance(target, ast.Subscript):
-        # d["k"] = tracer: reading ANY element of d may now yield it
-        _taint_target(target.value, add)
-
-
-def _propagate_taint(
-    body: list, tainted: Set[str], expr_tainted
-) -> bool:
-    """One propagation pass over every statement (nested defs included
-    — they trace as part of the same computation); True when the taint
-    set grew."""
-    changed = False
-
-    def add(name: Optional[str]) -> None:
-        nonlocal changed
-        if name and name not in tainted:
-            tainted.add(name)
-            changed = True
-
-    def call_args_tainted(call: ast.Call) -> bool:
-        return any(expr_tainted(a) for a in call.args) or any(
-            expr_tainted(k.value) for k in call.keywords
-        )
-
-    for stmt in body:
-        for node in ast.walk(stmt):
-            if isinstance(node, ast.Assign):
-                if expr_tainted(node.value):
-                    for t in node.targets:
-                        _taint_target(t, add)
-            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
-                if node.value is not None and (
-                    expr_tainted(node.value)
-                    or (
-                        isinstance(node, ast.AugAssign)
-                        and expr_tainted(node.target)
-                    )
-                ):
-                    _taint_target(node.target, add)
-            elif isinstance(node, ast.NamedExpr):
-                if expr_tainted(node.value):
-                    _taint_target(node.target, add)
-            elif isinstance(node, (ast.For, ast.AsyncFor)):
-                if expr_tainted(node.iter):
-                    _taint_target(node.target, add)
-            elif isinstance(node, ast.withitem):
-                if node.optional_vars is not None and expr_tainted(
-                    node.context_expr
-                ):
-                    _taint_target(node.optional_vars, add)
-            elif (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _CONTAINER_MUTATORS
-                and call_args_tainted(node)
-            ):
-                _taint_target(node.func.value, add)
-    return changed
 
 
 def _transform_name(node: ast.AST) -> Optional[str]:
@@ -202,34 +98,41 @@ def _decorator_transform(dec: ast.AST) -> Optional[str]:
 
 
 @register
-class TracerHygieneChecker(Checker):
+class TracerHygieneChecker(ProjectChecker):
     rule = "BTL010"
     title = "host-side operation inside a jit/shard_map traced function"
 
-    def check(self, ctx: CheckContext) -> Iterable[Finding]:
+    def check_project(self, project) -> Iterable[Finding]:
         findings: List[Finding] = []
-        module_names = self._module_level_names(ctx.tree)
+        summaries = get_summaries(project)
+        for mod in project.modules:
+            findings.extend(self._check_module(mod, project, summaries))
+        return findings
 
-        # name -> def node, for resolving jax.jit(one_client) call sites
+    def _check_module(self, mod, project, summaries) -> List[Finding]:
+        findings: List[Finding] = []
+        module_names = self._module_level_names(mod.tree)
+
+        # name -> (def node, class), for resolving jax.jit(one_client)
         defs_by_name = {}
-        for _qual, _cls, node in au.iter_function_defs(ctx.tree):
-            defs_by_name.setdefault(node.name, node)
+        for _qual, cls, node in au.iter_function_defs(mod.tree):
+            defs_by_name.setdefault(node.name, (node, cls))
 
-        traced: List[tuple] = []  # (node, how)
+        traced: List[tuple] = []  # (node, class_name, how)
         seen_ids: Set[int] = set()
 
-        def mark(node, how: str) -> None:
+        def mark(node, cls, how: str) -> None:
             if id(node) not in seen_ids:
                 seen_ids.add(id(node))
-                traced.append((node, how))
+                traced.append((node, cls, how))
 
-        for _qual, _cls, node in au.iter_function_defs(ctx.tree):
+        for _qual, cls, node in au.iter_function_defs(mod.tree):
             for dec in node.decorator_list:
                 t = _decorator_transform(dec)
                 if t is not None:
-                    mark(node, t)
+                    mark(node, cls, t)
 
-        for call in ast.walk(ctx.tree):
+        for call in ast.walk(mod.tree):
             if not isinstance(call, ast.Call) or not call.args:
                 continue
             t = _transform_name(call.func)
@@ -237,13 +140,16 @@ class TracerHygieneChecker(Checker):
                 continue
             target = call.args[0]
             if isinstance(target, ast.Lambda):
-                mark(target, t)
+                mark(target, None, t)
             elif isinstance(target, ast.Name) and target.id in defs_by_name:
-                mark(defs_by_name[target.id], t)
+                node, cls = defs_by_name[target.id]
+                mark(node, cls, t)
 
-        for node, how in traced:
+        for node, cls, how in traced:
             findings.extend(
-                self._scan_traced(node, how, module_names, ctx)
+                self._scan_traced(
+                    node, cls, how, module_names, mod, project, summaries
+                )
             )
         return findings
 
@@ -262,7 +168,7 @@ class TracerHygieneChecker(Checker):
         return names
 
     def _scan_traced(
-        self, fn, how: str, module_names: Set[str], ctx: CheckContext
+        self, fn, cls, how, module_names, mod, project, summaries
     ) -> List[Finding]:
         findings: List[Finding] = []
         label = getattr(fn, "name", "<lambda>")
@@ -287,9 +193,9 @@ class TracerHygieneChecker(Checker):
         # the conservative one-hop return rule). Iterate to a fixpoint:
         # `self._cache = x` early and `np.asarray(self._cache)` later
         # converge regardless of AST walk order.
-        touches_tracer = _make_taint_oracle(tainted)
+        touches_tracer = au.make_taint_oracle(tainted)
         for _ in range(10):  # fixpoint cap; real bodies settle in 2-3
-            if not _propagate_taint(body, tainted, touches_tracer):
+            if not au.propagate_taint(body, tainted, touches_tracer):
                 break
 
         for stmt in body:
@@ -297,7 +203,7 @@ class TracerHygieneChecker(Checker):
                 if isinstance(node, ast.Global):
                     findings.append(
                         Finding(
-                            self.rule, ctx.path, node.lineno,
+                            self.rule, mod.path, node.lineno,
                             node.col_offset,
                             f"`global {', '.join(node.names)}` {where}: "
                             f"trace-time side effects do not replay on "
@@ -321,7 +227,7 @@ class TracerHygieneChecker(Checker):
                         ):
                             findings.append(
                                 Finding(
-                                    self.rule, ctx.path, node.lineno,
+                                    self.rule, mod.path, node.lineno,
                                     node.col_offset,
                                     f"mutation of module state "
                                     f"`{au.dotted_name(t) or root.id}` "
@@ -331,17 +237,23 @@ class TracerHygieneChecker(Checker):
                             )
                 elif isinstance(node, ast.Call):
                     findings.extend(
-                        self._check_call(node, where, touches_tracer, ctx)
+                        self._check_call(node, where, touches_tracer, mod)
+                    )
+                    findings.extend(
+                        self._check_call_summary(
+                            node, cls, where, touches_tracer,
+                            mod, project, summaries,
+                        )
                     )
         return findings
 
-    def _check_call(self, call, where, touches_tracer, ctx):
+    def _check_call(self, call, where, touches_tracer, mod):
         out = []
         name = au.call_name(call)
         if name == "print":
             out.append(
                 Finding(
-                    self.rule, ctx.path, call.lineno, call.col_offset,
+                    self.rule, mod.path, call.lineno, call.col_offset,
                     f"print() {where} runs at trace time only; use "
                     f"jax.debug.print for per-call output",
                 )
@@ -349,7 +261,7 @@ class TracerHygieneChecker(Checker):
         elif name in _CASTS and call.args and touches_tracer(call.args[0]):
             out.append(
                 Finding(
-                    self.rule, ctx.path, call.lineno, call.col_offset,
+                    self.rule, mod.path, call.lineno, call.col_offset,
                     f"{name}() on a traced value {where} concretizes "
                     f"the tracer (ConcretizationTypeError or a forced "
                     f"device sync)",
@@ -364,7 +276,7 @@ class TracerHygieneChecker(Checker):
         ):
             out.append(
                 Finding(
-                    self.rule, ctx.path, call.lineno, call.col_offset,
+                    self.rule, mod.path, call.lineno, call.col_offset,
                     f"np.{call.func.attr}() on a traced value {where} "
                     f"materializes the tracer on host; use jnp.{call.func.attr}",
                 )
@@ -373,10 +285,42 @@ class TracerHygieneChecker(Checker):
             if not call.args and not call.keywords:
                 out.append(
                     Finding(
-                        self.rule, ctx.path, call.lineno, call.col_offset,
+                        self.rule, mod.path, call.lineno, call.col_offset,
                         f".item() {where} blocks on a device->host "
                         f"transfer per trace; return the array and "
                         f"concretize outside the jit boundary",
+                    )
+                )
+        return out
+
+    def _check_call_summary(
+        self, call, cls, where, touches_tracer, mod, project, summaries
+    ):
+        """Interprocedural leg: the callee's fixpoint summary carries
+        the host ops reachable through it (with witness chains)."""
+        out = []
+        args_tainted = any(
+            touches_tracer(a) for a in call.args
+        ) or any(
+            kw.value is not None and touches_tracer(kw.value)
+            for kw in call.keywords
+        )
+        for callee in project.resolve_call_multi(mod, cls, call):
+            summ = summaries.get(callee.key)
+            if summ is None:
+                continue
+            for (path, line, _c), (
+                needs, _kind, msg, chain,
+            ) in sorted(summ.taint_ops.items()):
+                if needs and not args_tainted:
+                    continue
+                full = (callee.qualname,) + chain
+                via = " -> ".join(f"{q}()" for q in full)
+                out.append(
+                    Finding(
+                        self.rule, mod.path, call.lineno, call.col_offset,
+                        f"call {where} reaches a host-side op via {via} "
+                        f"(at {path}:{line}): {msg}",
                     )
                 )
         return out

@@ -69,6 +69,10 @@ class Report:
     suppressed: int = 0
     files_checked: int = 0
     errors: List[str] = dataclasses.field(default_factory=list)
+    # incremental summary cache stats (run_paths with cache_path only):
+    # hits = files whose per-function facts were reused by content hash
+    cache_hits: int = 0
+    cache_misses: int = 0
 
     @property
     def clean(self) -> bool:
@@ -78,12 +82,47 @@ class Report:
 _ALLOW_RE = re.compile(r"#\s*batonlint:\s*allow\[([^\]]*)\]")
 
 
+def _comment_lines(source: str):
+    """``(lineno, comment_text)`` for every REAL comment token.
+
+    Tokenizing (rather than regex over raw lines) keeps ``allow[...]``
+    text inside docstrings and string literals — rule documentation,
+    fixture sources embedded in tests — from acting as (and being
+    audited as) live suppressions.  Sources that fail to tokenize fall
+    back to the raw-line scan so a stray ``\\x0c`` can't disable
+    suppressions wholesale."""
+    import io
+    import tokenize
+
+    try:
+        tokens = list(
+            tokenize.generate_tokens(io.StringIO(source).readline)
+        )
+    except (tokenize.TokenError, IndentationError, SyntaxError,
+            ValueError):
+        return [
+            (lineno, text)
+            for lineno, text in enumerate(source.splitlines(), start=1)
+            if "#" in text
+        ]
+    return [
+        (tok.start[0], tok.string)
+        for tok in tokens
+        if tok.type == tokenize.COMMENT
+    ]
+
+
 class Suppressions:
-    """Per-line ``# batonlint: allow[RULE1,RULE2]`` / ``allow[*]`` map."""
+    """Per-line ``# batonlint: allow[RULE1,RULE2]`` / ``allow[*]`` map.
+
+    Each suppression that actually absorbs a finding is recorded in
+    ``used`` (``line -> {rules it silenced}``) so the BTL000 audit can
+    flag allow comments that no longer silence anything."""
 
     def __init__(self, source: str) -> None:
         self._by_line: Dict[int, frozenset] = {}
-        for lineno, text in enumerate(source.splitlines(), start=1):
+        self.used: Dict[int, set] = {}
+        for lineno, text in _comment_lines(source):
             m = _ALLOW_RE.search(text)
             if m:
                 rules = frozenset(
@@ -93,13 +132,30 @@ class Suppressions:
 
     def allows(self, line: int, rule: str) -> bool:
         rules = self._by_line.get(line)
-        return rules is not None and (rule in rules or "*" in rules)
+        if rules is None:
+            return False
+        if rule == "BTL000":
+            # the stale-suppression audit may only be silenced by an
+            # EXPLICIT allow[BTL000]: otherwise a stale `allow[*]`
+            # would absorb its own staleness finding and never surface
+            return rule in rules
+        return rule in rules or "*" in rules
+
+    def match(self, finding: Finding) -> Optional[int]:
+        """First line whose allow comment covers the finding, else
+        None.  Marks that line as used for the finding's rule."""
+        for line in (finding.line, *finding.also_lines):
+            if self.allows(line, finding.rule):
+                self.used.setdefault(line, set()).add(finding.rule)
+                return line
+        return None
 
     def allows_finding(self, finding: Finding) -> bool:
-        return any(
-            self.allows(line, finding.rule)
-            for line in (finding.line, *finding.also_lines)
-        )
+        return self.match(finding) is not None
+
+    def entries(self):
+        """``(line, frozenset_of_rule_tokens)`` pairs, source order."""
+        return sorted(self._by_line.items())
 
 
 def _normalize_registry(reg) -> Optional[dict]:
@@ -241,6 +297,7 @@ def _run_project(
     suppressions = {m.path: Suppressions(m.source) for m in project.modules}
     findings: List[Finding] = []
     seen = set()
+    crashed: set = set()
 
     def wanted(path: str) -> bool:
         return only_paths is None or _normalize_path(path) in only_paths
@@ -250,10 +307,14 @@ def _run_project(
         if key in seen:
             return
         seen.add(key)
+        # match suppressions BEFORE the --changed-only filter: usage
+        # marks must be complete for the BTL000 stale-suppression audit
+        # even when the finding's file isn't being reported on
+        supp = suppressions.get(f.path)
+        suppressed = supp is not None and supp.allows_finding(f)
         if not wanted(f.path):
             return
-        supp = suppressions.get(f.path)
-        if supp is not None and supp.allows_finding(f):
+        if suppressed:
             report.suppressed += 1
         else:
             findings.append(f)
@@ -277,6 +338,7 @@ def _run_project(
                 report.errors.append(
                     f"{mod.path}: checker {checker.rule} crashed: {exc!r}"
                 )
+                crashed.add(checker.rule)
                 continue
             for f in raw:
                 admit(f)
@@ -289,12 +351,60 @@ def _run_project(
             report.errors.append(
                 f"checker {checker.rule} crashed: {exc!r}"
             )
+            crashed.add(checker.rule)
             continue
         for f in raw:
+            admit(f)
+    if any(c.rule == "BTL000" for c in checkers):
+        for f in _audit_suppressions(
+            project, checkers, suppressions, crashed, wanted, only_paths
+        ):
             admit(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     report.findings.extend(findings)
     return findings
+
+
+def _audit_suppressions(
+    project, checkers, suppressions, crashed, wanted, only_paths
+) -> List[Finding]:
+    """BTL000 — an ``allow[RULE]`` that silences nothing is itself a
+    finding: it documents a hazard that no longer exists (or never
+    did), and it will hide the next REAL instance introduced on that
+    line.  Runs after every other checker so usage marks are complete.
+
+    A named token is audited only when its rule actually ran this pass
+    without crashing; ``*`` tokens are stale when the line silenced
+    nothing at all.  Under ``--changed-only`` the per-file pass skips
+    unchanged files, so only files in the filter are audited."""
+    ran = {c.rule for c in checkers if c.rule != "BTL000"} - crashed
+    out: List[Finding] = []
+    for mod in project.modules:
+        # files outside --changed-only never ran the per-file pass, so
+        # their per-file-rule suppressions would all look stale
+        if only_paths is not None and not wanted(mod.path):
+            continue
+        supp = suppressions.get(mod.path)
+        if supp is None:
+            continue
+        for line, tokens in supp.entries():
+            used = supp.used.get(line, set())
+            for tok in sorted(tokens):
+                if tok == "*":
+                    if not used:
+                        out.append(Finding(
+                            "BTL000", mod.path, line, 0,
+                            "stale suppression: `allow[*]` silences "
+                            "nothing on this line; remove it",
+                        ))
+                elif tok in ran and tok not in used:
+                    out.append(Finding(
+                        "BTL000", mod.path, line, 0,
+                        f"stale suppression: `allow[{tok}]` but {tok} "
+                        f"no longer fires here; remove it (stale "
+                        f"allows hide the next real instance)",
+                    ))
+    return out
 
 
 def _normalize_path(path: str) -> str:
@@ -474,10 +584,74 @@ def _parse_counter_registry(
     }
 
 
+CACHE_VERSION = 1
+
+
+def _load_summary_cache(cache_path: str, entries) -> Dict[str, dict]:
+    """``{path: {qual: LocalFacts}}`` for entries whose content hash
+    matches the cache file; unreadable/stale/corrupt caches are just
+    misses."""
+    import hashlib
+
+    from baton_tpu.analysis.summaries import LocalFacts
+
+    try:
+        data = json.loads(
+            pathlib.Path(cache_path).read_text(encoding="utf-8")
+        )
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict) or data.get("version") != CACHE_VERSION:
+        return {}
+    files = data.get("files", {})
+    out: Dict[str, dict] = {}
+    for path, source, _tree, _reg in entries:
+        rec = files.get(path)
+        if not isinstance(rec, dict):
+            continue
+        digest = hashlib.sha256(source.encode("utf-8")).hexdigest()
+        if rec.get("hash") != digest:
+            continue
+        try:
+            out[path] = {
+                qual: LocalFacts.from_json(lf)
+                for qual, lf in rec.get("functions", {}).items()
+            }
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
+def _write_summary_cache(cache_path: str, project, summaries) -> None:
+    import hashlib
+
+    files = {}
+    for mod in project.modules:
+        facts = summaries.local_facts_by_path.get(mod.path)
+        if facts is None:
+            continue
+        files[mod.path] = {
+            "hash": hashlib.sha256(
+                mod.source.encode("utf-8")
+            ).hexdigest(),
+            "functions": {
+                qual: lf.to_json() for qual, lf in facts.items()
+            },
+        }
+    payload = {"version": CACHE_VERSION, "files": files}
+    try:
+        pathlib.Path(cache_path).write_text(
+            json.dumps(payload, sort_keys=True), encoding="utf-8"
+        )
+    except OSError:
+        pass  # a read-only checkout must not fail the lint
+
+
 def run_paths(
     paths: Sequence[str],
     rules: Optional[Sequence[str]] = None,
     only_paths: Optional[Sequence[str]] = None,
+    cache_path: Optional[str] = None,
 ) -> Report:
     """Lint files/directories; the CLI and test-suite entry point.
 
@@ -486,6 +660,12 @@ def run_paths(
     ``only_paths`` (the ``--changed-only`` filter) restricts the
     per-file pass and the REPORTED findings to those files while the
     project pass still reads everything.
+
+    ``cache_path`` enables the incremental summary cache: per-function
+    local facts are reloaded for files whose sha256 content hash is
+    unchanged (skipping their extraction walk — the global fixpoint
+    always reruns) and the file is rewritten after the run.  Hit/miss
+    counts land on ``report.cache_hits``/``report.cache_misses``.
     """
     report = Report()
     registry_cache: Dict[str, Optional[dict]] = {}
@@ -510,7 +690,19 @@ def run_paths(
         if only_paths is not None
         else None
     )
-    _run_project(_build_project(entries), rules, report, only_paths=only)
+    project = _build_project(entries)
+    if cache_path is not None:
+        project._cached_local_facts = _load_summary_cache(
+            cache_path, entries
+        )
+    _run_project(project, rules, report, only_paths=only)
+    if cache_path is not None:
+        from baton_tpu.analysis.summaries import get_summaries
+
+        summaries = get_summaries(project)  # built by checkers or now
+        report.cache_hits = len(summaries.cache_hits)
+        report.cache_misses = len(summaries.cache_misses)
+        _write_summary_cache(cache_path, project, summaries)
     return report
 
 
@@ -536,6 +728,10 @@ def format_json(report: Report) -> str:
             "suppressed": report.suppressed,
             "files_checked": report.files_checked,
             "errors": list(report.errors),
+            "cache": {
+                "hits": report.cache_hits,
+                "misses": report.cache_misses,
+            },
         },
         indent=2,
         sort_keys=True,

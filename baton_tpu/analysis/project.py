@@ -19,9 +19,15 @@ contract as the rest of batonlint):
   package;
 * a call resolves through (1) same-module functions/methods
   (``self.helper`` -> ``Class.helper``), (2) an imported symbol, or
-  (3) ``alias.attr`` where the alias names a project module.  Dynamic
-  dispatch, inheritance, and re-exports are out of scope — a resolver
-  miss returns ``None`` and the caller degrades to per-file behavior.
+  (3) ``alias.attr`` where the alias names a project module;
+* since the class-hierarchy layer landed, ``self.method()`` also
+  resolves through inheritance: the nearest definition up the base
+  chain PLUS every override in known subclasses (class-hierarchy
+  analysis — the receiver's dynamic type may be any subclass of the
+  enclosing class), and ``super().method()`` resolves to the nearest
+  base-class definition.  Re-exports and true dynamic dispatch
+  (``getattr``, HOFs) remain out of scope — a resolver miss returns
+  ``None``/``[]`` and the caller degrades to per-file behavior.
 """
 
 from __future__ import annotations
@@ -33,7 +39,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from baton_tpu.analysis import _astutil as au
 
-__all__ = ["FunctionInfo", "ModuleInfo", "Project"]
+__all__ = ["ClassInfo", "FunctionInfo", "ModuleInfo", "Project"]
 
 
 @dataclasses.dataclass
@@ -53,6 +59,25 @@ class FunctionInfo:
     @property
     def is_async(self) -> bool:
         return isinstance(self.node, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    """One class definition: enough to build the inheritance graph."""
+
+    name: str                     # bare class name
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    base_names: Tuple[str, ...]   # raw dotted base expressions
+
+    @property
+    def key(self) -> str:
+        """Project-unique id: ``module.dotted.name:ClassName``."""
+        return f"{self.module.name}:{self.name}"
+
+    def method(self, name: str) -> Optional["FunctionInfo"]:
+        """The method defined ON this class (no inheritance walk)."""
+        return self.module.functions.get(f"{self.name}.{name}")
 
 
 class ModuleInfo:
@@ -79,6 +104,16 @@ class ModuleInfo:
                 qual, FunctionInfo(qual, cls, node, self)
             )
         self.imports = _collect_imports(tree, name)
+        self.classes: Dict[str, ClassInfo] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                bases = tuple(
+                    b for b in (au.dotted_name(base) for base in node.bases)
+                    if b is not None
+                )
+                self.classes.setdefault(
+                    node.name, ClassInfo(node.name, self, node, bases)
+                )
 
 
 def _module_name_for(path: str) -> str:
@@ -140,6 +175,9 @@ class Project:
         self.modules: List[ModuleInfo] = []
         self.by_path: Dict[str, ModuleInfo] = {}
         self.by_name: Dict[str, ModuleInfo] = {}
+        self._hier: Optional[Tuple[Dict[str, List[str]],
+                                   Dict[str, List[str]],
+                                   Dict[str, ClassInfo]]] = None
 
     @classmethod
     def from_parsed(
@@ -180,14 +218,205 @@ class Project:
                     return hit
         return None
 
+    # -- class hierarchy (CHA) -----------------------------------------
+    def _hierarchy(self):
+        """``(parents, children, by_key)`` over every known class; built
+        once per project, cycle-tolerant (a recursive base chain just
+        stops unifying where the cycle closes)."""
+        if self._hier is not None:
+            return self._hier
+        by_key: Dict[str, ClassInfo] = {}
+        for mod in self.modules:
+            for ci in mod.classes.values():
+                by_key.setdefault(ci.key, ci)
+        parents: Dict[str, List[str]] = {}
+        children: Dict[str, List[str]] = {}
+        for ci in by_key.values():
+            for base in ci.base_names:
+                parent = self._resolve_class_name(ci.module, base)
+                if parent is None or parent.key == ci.key:
+                    continue
+                parents.setdefault(ci.key, []).append(parent.key)
+                children.setdefault(parent.key, []).append(ci.key)
+        self._hier = (parents, children, by_key)
+        return self._hier
+
+    def _class_by_dotted(self, dotted: str) -> Optional[ClassInfo]:
+        parts = dotted.split(".")
+        if len(parts) < 2:
+            return None
+        mod = self.by_name.get(".".join(parts[:-1]))
+        if mod is None:
+            return None
+        return mod.classes.get(parts[-1])
+
+    def _resolve_class_name(
+        self, mod: ModuleInfo, dotted: str
+    ) -> Optional[ClassInfo]:
+        """A base-class expression (``Base``, ``pkg.Base``, imported
+        alias) -> the ClassInfo it names, when it is a project class."""
+        root, _, rest = dotted.partition(".")
+        if not rest:
+            ci = mod.classes.get(dotted)
+            if ci is not None:
+                return ci
+            target = mod.imports.get(dotted)
+            return self._class_by_dotted(target) if target else None
+        target = mod.imports.get(root)
+        if target is not None:
+            return self._class_by_dotted(f"{target}.{rest}")
+        return self._class_by_dotted(dotted)
+
+    def class_info(
+        self, mod: ModuleInfo, class_name: Optional[str]
+    ) -> Optional[ClassInfo]:
+        if class_name is None:
+            return None
+        return mod.classes.get(class_name)
+
+    def ancestors(self, ci: ClassInfo) -> List[ClassInfo]:
+        """Base classes of ``ci``, nearest first (BFS, cycle-safe)."""
+        parents, _children, by_key = self._hierarchy()
+        out: List[ClassInfo] = []
+        seen = {ci.key}
+        frontier = list(parents.get(ci.key, []))
+        while frontier:
+            nxt: List[str] = []
+            for key in frontier:
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(by_key[key])
+                nxt.extend(parents.get(key, []))
+            frontier = nxt
+        return out
+
+    def descendants(self, ci: ClassInfo) -> List[ClassInfo]:
+        """Known subclasses of ``ci``, transitively (BFS, cycle-safe)."""
+        _parents, children, by_key = self._hierarchy()
+        out: List[ClassInfo] = []
+        seen = {ci.key}
+        frontier = list(children.get(ci.key, []))
+        while frontier:
+            nxt: List[str] = []
+            for key in frontier:
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(by_key[key])
+                nxt.extend(children.get(key, []))
+            frontier = nxt
+        return out
+
+    def root_class_name(
+        self, mod: ModuleInfo, class_name: Optional[str]
+    ) -> Optional[str]:
+        """Bare name of the topmost known ancestor of ``class_name`` —
+        the namespace ``self.attr`` state and locks unify under, so a
+        lock acquired in ``Sub`` and one in ``Base`` name the same
+        object when ``Sub(Base)``."""
+        if class_name is None:
+            return None
+        ci = self.class_info(mod, class_name)
+        if ci is None:
+            return class_name
+        chain = self.ancestors(ci)
+        return chain[-1].name if chain else ci.name
+
+    def resolve_method(
+        self, ci: ClassInfo, method: str
+    ) -> Optional[FunctionInfo]:
+        """Nearest definition of ``method`` on ``ci`` or up its bases."""
+        hit = ci.method(method)
+        if hit is not None:
+            return hit
+        for base in self.ancestors(ci):
+            hit = base.method(method)
+            if hit is not None:
+                return hit
+        return None
+
+    def method_candidates(
+        self, ci: ClassInfo, method: str
+    ) -> List[FunctionInfo]:
+        """CHA dispatch set for ``self.method()`` in class ``ci``: the
+        nearest inherited definition plus every override in known
+        subclasses (the receiver may be any subclass instance)."""
+        out: List[FunctionInfo] = []
+        seen: set = set()
+
+        def add(fn: Optional[FunctionInfo]) -> None:
+            if fn is not None and fn.key not in seen:
+                seen.add(fn.key)
+                out.append(fn)
+
+        add(self.resolve_method(ci, method))
+        for sub in self.descendants(ci):
+            add(sub.method(method))
+        return out
+
+    # -- call resolution -----------------------------------------------
+    def resolve_call_multi(
+        self,
+        mod: ModuleInfo,
+        class_name: Optional[str],
+        call: ast.Call,
+    ) -> List[FunctionInfo]:
+        """Every function this call may statically dispatch to.
+
+        ``self.method()``/``cls.method()`` resolve through the class
+        hierarchy (nearest definition up the bases plus all subclass
+        overrides); ``super().method()`` to the nearest base
+        definition; everything else to at most one candidate via the
+        module symbol table."""
+        func = call.func
+        ci = self.class_info(mod, class_name)
+        # super().method(...)
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Call)
+            and isinstance(func.value.func, ast.Name)
+            and func.value.func.id == "super"
+        ):
+            if ci is None:
+                return []
+            own = ci.method(func.attr)
+            for base in self.ancestors(ci):
+                hit = base.method(func.attr)
+                if hit is not None and (own is None or hit.key != own.key):
+                    return [hit]
+            return []
+        # self.method(...) / cls.method(...)
+        if (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("self", "cls")
+        ):
+            if ci is not None:
+                hits = self.method_candidates(ci, func.attr)
+                if hits:
+                    return hits
+            # no hierarchy info: fall through to the legacy single-shot
+        single = self._resolve_call_single(mod, class_name, call)
+        return [single] if single is not None else []
+
     def resolve_call(
         self,
         mod: ModuleInfo,
         class_name: Optional[str],
         call: ast.Call,
     ) -> Optional[FunctionInfo]:
-        """Best-effort static resolution of a call expression made from
-        inside ``mod`` (``class_name`` = enclosing class, for ``self.``)."""
+        """Best-effort single-target resolution (primary candidate —
+        the nearest-MRO definition for ``self.`` calls)."""
+        hits = self.resolve_call_multi(mod, class_name, call)
+        return hits[0] if hits else None
+
+    def _resolve_call_single(
+        self,
+        mod: ModuleInfo,
+        class_name: Optional[str],
+        call: ast.Call,
+    ) -> Optional[FunctionInfo]:
         local = au.resolve_local_call(call, class_name)
         if local is not None:
             hit = mod.functions.get(local)

@@ -1,0 +1,737 @@
+"""Bottom-up fixpoint function summaries over the project call graph.
+
+The per-function AST walks in the checkers see one hop; this module
+sees the whole program.  For every function it computes a
+:class:`FnSummary` — the blocking primitives it may execute, the locks
+it may acquire, the locks it holds across an await, the network awaits
+it may perform, which ``self.*`` attributes it reads and mutates, and
+its parameter->return taint transfer — first locally (one shallow AST
+walk per function, the part that is cacheable per file content hash),
+then propagated bottom-up over the call graph: strongly connected
+components are condensed (Tarjan) and processed in reverse topological
+order, iterating each SCC's members to a fixpoint, so mutual recursion
+converges and every rule built on summaries is genuinely multi-hop.
+
+Propagation follows execution, not just reference: an edge from a
+*sync* caller into an ``async def`` does not propagate effects (the
+call merely builds a coroutine object), while async->async, async->sync
+and sync->sync edges do.  ``self.*`` effect sets propagate only over
+``self.``/``super()`` edges — a method called on some *other* object
+mutates that object's state, not the caller's.
+
+Every site a summary carries keeps the shortest witness call chain
+(qualnames below the summarized function), so checkers can report the
+path a hazard travels across modules, not just its endpoint.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from baton_tpu.analysis import _astutil as au
+from baton_tpu.analysis.callgraph import CallEdge, CallGraph
+from baton_tpu.analysis.project import ModuleInfo, Project
+
+__all__ = [
+    "BLOCKED_DOTTED",
+    "BLOCKED_METHODS",
+    "BLOCKED_MODULE_PREFIXES",
+    "BLOCKED_NAMES",
+    "FnSummary",
+    "LocalFacts",
+    "NETWORK_ATTRS",
+    "NETWORK_DOTTED",
+    "Site",
+    "Summaries",
+    "blocked_reason",
+    "is_network_call",
+    "lock_identity",
+]
+
+# -- blocking primitives (shared with BTL001) --------------------------
+# fully-resolved dotted names that block the loop
+BLOCKED_DOTTED = {
+    "time.sleep": "time.sleep() blocks the event loop; await asyncio.sleep",
+    "pickle.load": "pickle.load() is blocking CPU/IO work",
+    "pickle.loads": "pickle.loads() is blocking CPU work",
+    "jax.device_get": "jax.device_get() blocks on device transfer",
+}
+# any call into these modules blocks (compression is pure CPU burn)
+BLOCKED_MODULE_PREFIXES = ("zlib.",)
+# bare-name builtins
+BLOCKED_NAMES = {"open": "open() is blocking file I/O"}
+# method attributes that block regardless of receiver type
+BLOCKED_METHODS = {
+    "block_until_ready": ".block_until_ready() blocks on device compute",
+    "read_text": "file I/O (.read_text) blocks the event loop",
+    "write_text": "file I/O (.write_text) blocks the event loop",
+    "read_bytes": "file I/O (.read_bytes) blocks the event loop",
+    "write_bytes": "file I/O (.write_bytes) blocks the event loop",
+}
+
+
+def blocked_reason(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """``(display_name, reason)`` when the call is a blocking
+    primitive, else None."""
+    name = au.call_name(call)
+    if name is not None:
+        if name in BLOCKED_DOTTED:
+            return name, BLOCKED_DOTTED[name]
+        for prefix in BLOCKED_MODULE_PREFIXES:
+            if name.startswith(prefix):
+                return name, f"{prefix}* compression is blocking CPU work"
+        if name in BLOCKED_NAMES:
+            return name, BLOCKED_NAMES[name]
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in BLOCKED_METHODS:
+        display = name if name is not None else f"<expr>.{func.attr}"
+        return display, BLOCKED_METHODS[func.attr]
+    return None
+
+
+# -- network/queue await primitives (shared with BTL002) ---------------
+# attribute names that mean "this await leaves the process" (HTTP verb,
+# body read, queue hand-off) — receiver-agnostic by design: sessions,
+# responses and queues go by many names
+NETWORK_ATTRS = {
+    "get", "post", "put", "patch", "delete", "head", "request",
+    "read", "text", "json", "recv", "receive", "send", "send_json",
+    "fetch", "connect", "join", "drain",
+}
+NETWORK_DOTTED = {"asyncio.sleep"}
+
+
+def is_network_call(call: ast.Call) -> bool:
+    dotted = au.call_name(call)
+    if dotted in NETWORK_DOTTED:
+        return True
+    return (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in NETWORK_ATTRS
+    )
+
+
+# -- lock identity -----------------------------------------------------
+def lock_identity(
+    expr_or_name,
+    class_name: Optional[str],
+    mod: ModuleInfo,
+    project: Optional[Project] = None,
+) -> Optional[str]:
+    """Normalized project-wide lock identity for an ``async with``
+    context expression (or its pre-extracted dotted name), or None when
+    the context is not a lock.
+
+    A "lock" is any context whose name ends with ``lock`` or ``mutex``
+    — naming convention as lint contract.  Identities unify where
+    references can: ``self._x_lock`` unifies under the ROOT class of
+    the enclosing class's known inheritance chain (so the same
+    attribute acquired in a base method and a subclass override is one
+    lock), a module-global is ``pkg.mod.x_lock`` from its home module
+    or through any import alias.  Locks reached through other objects'
+    attributes stay module-local (no type inference)."""
+    if isinstance(expr_or_name, str):
+        name: Optional[str] = expr_or_name
+    else:
+        name = au.dotted_name(expr_or_name)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1].lower()
+    if not (leaf.endswith("lock") or leaf.endswith("mutex")):
+        return None
+    root, _, rest = name.partition(".")
+    if root in ("self", "cls") and rest and class_name is not None:
+        owner = class_name
+        if project is not None:
+            owner = project.root_class_name(mod, class_name) or class_name
+        return f"{owner}.{rest}"
+    if rest:
+        target = mod.imports.get(root)
+        if target is not None:
+            # module-global lock referenced through an import alias:
+            # unify with its home-module bare name
+            return f"{target}.{rest}"
+        return f"{mod.name}:{name}"  # some other object's attribute
+    return f"{mod.name}.{name}"
+
+
+# -- self.* attribute access extraction --------------------------------
+_SELF_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "set",
+}
+
+
+def _self_attr_of(node: ast.AST) -> Optional[str]:
+    """``self.A``/``cls.A`` (possibly deeper: ``self.A.b``) -> ``A``."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")
+        ):
+            return node.attr
+        node = node.value
+    return None
+
+
+# -- local facts (cacheable) -------------------------------------------
+Site = Tuple[int, int]  # (line, col) within the function's own module
+
+
+@dataclasses.dataclass
+class LocalFacts:
+    """Per-function facts derived ONLY from that function's AST —
+    content-addressable, hence what ``.batonlint_cache.json`` stores."""
+
+    qual: str
+    class_name: Optional[str]
+    is_async: bool
+    has_await: bool
+    # ((line, col, display, reason), ...)
+    blocking: Tuple[Tuple[int, int, str, str], ...] = ()
+    # ((raw_dotted, line), ...) raw lock exprs from `async with`
+    acquires_raw: Tuple[Tuple[str, int], ...] = ()
+    # raw lock exprs held lexically at >=1 await
+    awaits_held_raw: Tuple[str, ...] = ()
+    # ((line, col, display), ...) awaited network/queue primitives
+    network_awaits: Tuple[Tuple[int, int, str], ...] = ()
+    # ((line, col, (raw_locks...)), ...) locks held at each call site
+    held_at_call: Tuple[Tuple[int, int, Tuple[str, ...]], ...] = ()
+    self_reads: Tuple[str, ...] = ()
+    self_writes: Tuple[str, ...] = ()
+    # ((needs_taint, kind, line, col, message), ...) host ops that are
+    # hazards when this function executes under a jit/shard_map trace
+    taint_ops: Tuple[Tuple[bool, str, int, int, str], ...] = ()
+    returns_param_taint: bool = False
+
+    def to_json(self) -> dict:
+        return {
+            "qual": self.qual,
+            "class_name": self.class_name,
+            "is_async": self.is_async,
+            "has_await": self.has_await,
+            "blocking": [list(x) for x in self.blocking],
+            "acquires_raw": [list(x) for x in self.acquires_raw],
+            "awaits_held_raw": list(self.awaits_held_raw),
+            "network_awaits": [list(x) for x in self.network_awaits],
+            "held_at_call": [
+                [line, col, list(locks)]
+                for line, col, locks in self.held_at_call
+            ],
+            "self_reads": list(self.self_reads),
+            "self_writes": list(self.self_writes),
+            "taint_ops": [list(x) for x in self.taint_ops],
+            "returns_param_taint": self.returns_param_taint,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "LocalFacts":
+        return cls(
+            qual=data["qual"],
+            class_name=data.get("class_name"),
+            is_async=bool(data["is_async"]),
+            has_await=bool(data["has_await"]),
+            blocking=tuple(
+                (int(a), int(b), str(c), str(d))
+                for a, b, c, d in data.get("blocking", [])
+            ),
+            acquires_raw=tuple(
+                (str(a), int(b)) for a, b in data.get("acquires_raw", [])
+            ),
+            awaits_held_raw=tuple(
+                str(x) for x in data.get("awaits_held_raw", [])
+            ),
+            network_awaits=tuple(
+                (int(a), int(b), str(c))
+                for a, b, c in data.get("network_awaits", [])
+            ),
+            held_at_call=tuple(
+                (int(line), int(col), tuple(str(x) for x in locks))
+                for line, col, locks in data.get("held_at_call", [])
+            ),
+            self_reads=tuple(str(x) for x in data.get("self_reads", [])),
+            self_writes=tuple(str(x) for x in data.get("self_writes", [])),
+            taint_ops=tuple(
+                (bool(a), str(b), int(c), int(d), str(e))
+                for a, b, c, d, e in data.get("taint_ops", [])
+            ),
+            returns_param_taint=bool(data.get("returns_param_taint", False)),
+        )
+
+
+_SUSPENDERS = (ast.Await, ast.AsyncFor, ast.AsyncWith)
+
+
+def compute_local_facts(mod: ModuleInfo) -> Dict[str, LocalFacts]:
+    """``{qualname: LocalFacts}`` for every function in the module."""
+    out: Dict[str, LocalFacts] = {}
+    for fn_info in mod.functions.values():
+        out[fn_info.qualname] = _local_facts_for(fn_info)
+    return out
+
+
+def _local_facts_for(fn_info) -> LocalFacts:
+    node = fn_info.node
+    is_async = isinstance(node, ast.AsyncFunctionDef)
+    blocking: List[Tuple[int, int, str, str]] = []
+    acquires_raw: List[Tuple[str, int]] = []
+    awaits_held_raw: set = set()
+    network_awaits: List[Tuple[int, int, str]] = []
+    held_at_call: List[Tuple[int, int, Tuple[str, ...]]] = []
+    self_reads: set = set()
+    self_writes: set = set()
+    has_await = False
+
+    def is_lock_name(name: Optional[str]) -> bool:
+        if name is None:
+            return False
+        leaf = name.rsplit(".", 1)[-1].lower()
+        return leaf.endswith("lock") or leaf.endswith("mutex")
+
+    def visit(n: ast.AST, held: Tuple[str, ...]) -> None:
+        nonlocal has_await
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return  # separate execution context (to_thread closures)
+        if isinstance(n, _SUSPENDERS):
+            has_await = True
+            awaits_held_raw.update(held)
+        if isinstance(n, ast.AsyncWith):
+            new_held = held
+            header = [i.context_expr for i in n.items]
+            for item in n.items:
+                expr = item.context_expr
+                raw = au.dotted_name(expr)
+                if is_lock_name(raw):
+                    acquires_raw.append((raw, n.lineno))
+                    new_held = new_held + (raw,)
+                elif isinstance(expr, ast.Call):
+                    if is_network_call(expr):
+                        network_awaits.append(
+                            (expr.lineno, expr.col_offset,
+                             au.call_name(expr)
+                             or f"<expr>.{expr.func.attr}")
+                        )
+                    held_at_call.append(
+                        (expr.lineno, expr.col_offset, held)
+                    )
+                    for child in ast.iter_child_nodes(expr):
+                        visit(child, held)
+            for child in ast.iter_child_nodes(n):
+                if child in header or isinstance(child, ast.withitem):
+                    continue
+                visit(child, new_held)
+            return
+        if isinstance(n, ast.Await) and isinstance(n.value, ast.Call):
+            if is_network_call(n.value):
+                network_awaits.append(
+                    (n.value.lineno, n.value.col_offset,
+                     au.call_name(n.value)
+                     or f"<expr>.{n.value.func.attr}")
+                )
+        if isinstance(n, ast.Call):
+            reason = blocked_reason(n)
+            if reason is not None:
+                blocking.append(
+                    (n.lineno, n.col_offset, reason[0], reason[1])
+                )
+            held_at_call.append((n.lineno, n.col_offset, held))
+        if isinstance(n, ast.Attribute):
+            attr = (
+                n.attr
+                if isinstance(n.value, ast.Name)
+                and n.value.id in ("self", "cls")
+                else None
+            )
+            if attr is not None:
+                if isinstance(n.ctx, (ast.Store, ast.Del)):
+                    self_writes.add(attr)
+                else:
+                    self_reads.add(attr)
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                n.targets if isinstance(n, ast.Assign) else [n.target]
+            )
+            for t in targets:
+                if isinstance(t, (ast.Subscript, ast.Attribute)):
+                    attr = _self_attr_of(t)
+                    if attr is not None:
+                        self_writes.add(attr)
+        if (
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr in _SELF_MUTATORS
+        ):
+            attr = _self_attr_of(n.func.value)
+            if attr is not None:
+                self_writes.add(attr)
+        for child in ast.iter_child_nodes(n):
+            visit(child, held)
+
+    for stmt in node.body:
+        visit(stmt, ())
+
+    taint_ops, returns_taint = _local_taint_facts(node)
+    return LocalFacts(
+        qual=fn_info.qualname,
+        class_name=fn_info.class_name,
+        is_async=is_async,
+        has_await=has_await,
+        blocking=tuple(blocking),
+        acquires_raw=tuple(acquires_raw),
+        awaits_held_raw=tuple(sorted(awaits_held_raw)),
+        network_awaits=tuple(network_awaits),
+        held_at_call=tuple(held_at_call),
+        self_reads=tuple(sorted(self_reads)),
+        self_writes=tuple(sorted(self_writes)),
+        taint_ops=taint_ops,
+        returns_param_taint=returns_taint,
+    )
+
+
+def _local_taint_facts(node) -> Tuple[tuple, bool]:
+    """Host-side ops in this function that become hazards under a JAX
+    trace, plus whether the return value derives from the parameters.
+
+    ``needs_taint`` ops (casts, np materializers, ``.item()``) fire
+    only when the function is CALLED with traced arguments; ``print``
+    is a hazard in any traced execution (it runs at trace time only)."""
+    tainted = au.param_names(node) - {"self", "cls"}
+    body = node.body if isinstance(node.body, list) else [node.body]
+    oracle = au.make_taint_oracle(tainted)
+    for _ in range(10):
+        if not au.propagate_taint(body, tainted, oracle):
+            break
+
+    ops: List[Tuple[bool, str, int, int, str]] = []
+    returns_taint = False
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(n, ast.Return) and n.value is not None:
+                if oracle(n.value):
+                    returns_taint = True
+            if not isinstance(n, ast.Call):
+                continue
+            name = au.call_name(n)
+            if name == "print":
+                ops.append(
+                    (False, "print", n.lineno, n.col_offset,
+                     "print() runs at trace time only; use "
+                     "jax.debug.print for per-call output")
+                )
+            elif (
+                name in ("float", "int", "bool", "complex")
+                and n.args
+                and oracle(n.args[0])
+            ):
+                ops.append(
+                    (True, "cast", n.lineno, n.col_offset,
+                     f"{name}() on a value derived from the caller's "
+                     f"traced arguments concretizes the tracer")
+                )
+            elif (
+                isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("asarray", "array", "copy")
+                and au.dotted_name(n.func.value) in ("np", "numpy")
+                and n.args
+                and oracle(n.args[0])
+            ):
+                ops.append(
+                    (True, "materialize", n.lineno, n.col_offset,
+                     f"np.{n.func.attr}() on a value derived from the "
+                     f"caller's traced arguments materializes the "
+                     f"tracer on host; use jnp.{n.func.attr}")
+                )
+            elif (
+                isinstance(n.func, ast.Attribute)
+                and n.func.attr == "item"
+                and not n.args and not n.keywords
+                and oracle(n.func.value)
+            ):
+                ops.append(
+                    (True, "item", n.lineno, n.col_offset,
+                     ".item() on a value derived from the caller's "
+                     "traced arguments blocks on a device->host "
+                     "transfer per trace")
+                )
+    return tuple(ops), returns_taint
+
+
+# -- fixpoint summaries ------------------------------------------------
+@dataclasses.dataclass
+class FnSummary:
+    """What one function may do, including everything reachable through
+    its resolved calls.  Site dicts map ``(path, line, col)`` to a
+    payload whose last element is the witness chain (qualnames below
+    this function, shortest first discovered)."""
+
+    key: str
+    qualname: str
+    is_async: bool
+    has_await: bool                     # this frame itself suspends
+    may_suspend: bool                   # suspends here or in a callee
+    # (path, line, col) -> (display, reason, chain)
+    blocking: Dict[tuple, tuple] = dataclasses.field(default_factory=dict)
+    # (path, line, col) -> (display, chain)
+    network_awaits: Dict[tuple, tuple] = dataclasses.field(
+        default_factory=dict
+    )
+    acquires: FrozenSet[str] = frozenset()
+    awaits_held: FrozenSet[str] = frozenset()
+    self_reads: FrozenSet[str] = frozenset()
+    self_writes: FrozenSet[str] = frozenset()
+    # (path, line, col) -> (needs_taint, kind, message, chain)
+    taint_ops: Dict[tuple, tuple] = dataclasses.field(default_factory=dict)
+    returns_param_taint: bool = False
+
+
+def _tarjan_sccs(
+    keys: Sequence[str], succ: Dict[str, List[str]]
+) -> List[List[str]]:
+    """Iterative Tarjan: SCCs in reverse topological order (every
+    successor SCC appears before its callers)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: set = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in keys:
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recursed = False
+            children = succ.get(node, [])
+            for i in range(pi, len(children)):
+                child = children[i]
+                if child not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((child, 0))
+                    recursed = True
+                    break
+                if child in on_stack:
+                    low[node] = min(low[node], index[child])
+            if recursed:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return sccs
+
+
+class Summaries:
+    """Fixpoint summaries for every function of a project.
+
+    ``cached_locals`` maps module path -> ``{qual: LocalFacts}`` for
+    files whose content hash matched the incremental cache; those
+    modules skip the local extraction walk entirely (the fixpoint
+    always reruns — it is global and cheap next to parsing)."""
+
+    def __init__(
+        self,
+        project: Project,
+        graph: Optional[CallGraph] = None,
+        cached_locals: Optional[Dict[str, Dict[str, LocalFacts]]] = None,
+    ) -> None:
+        self.project = project
+        self.graph = graph if graph is not None else CallGraph(project)
+        self.locals: Dict[str, LocalFacts] = {}
+        self.local_facts_by_path: Dict[str, Dict[str, LocalFacts]] = {}
+        self.cache_hits: List[str] = []
+        self.cache_misses: List[str] = []
+        cached_locals = cached_locals or {}
+        for mod in project.modules:
+            cached = cached_locals.get(mod.path)
+            if cached is not None and set(cached) == set(
+                fi.qualname for fi in mod.functions.values()
+            ):
+                facts = cached
+                self.cache_hits.append(mod.path)
+            else:
+                facts = compute_local_facts(mod)
+                self.cache_misses.append(mod.path)
+            self.local_facts_by_path[mod.path] = facts
+            for fi in mod.functions.values():
+                lf = facts.get(fi.qualname)
+                if lf is not None:
+                    self.locals[fi.key] = lf
+        self.by_key: Dict[str, FnSummary] = {}
+        self._compute()
+
+    def get(self, key: str) -> Optional[FnSummary]:
+        return self.by_key.get(key)
+
+    def for_function(self, fn_info) -> Optional[FnSummary]:
+        return self.by_key.get(fn_info.key)
+
+    # ------------------------------------------------------------------
+    def _compute(self) -> None:
+        project = self.project
+        graph = self.graph
+
+        # seed every function from its local facts
+        for fn in project.functions():
+            lf = self.locals.get(fn.key)
+            if lf is None:
+                continue
+            mod = fn.module
+            acquires = frozenset(
+                x for x in (
+                    lock_identity(raw, fn.class_name, mod, project)
+                    for raw, _line in lf.acquires_raw
+                ) if x is not None
+            )
+            awaits_held = frozenset(
+                x for x in (
+                    lock_identity(raw, fn.class_name, mod, project)
+                    for raw in lf.awaits_held_raw
+                ) if x is not None
+            )
+            summ = FnSummary(
+                key=fn.key,
+                qualname=fn.qualname,
+                is_async=lf.is_async,
+                has_await=lf.has_await,
+                may_suspend=lf.has_await,
+                acquires=acquires,
+                awaits_held=awaits_held,
+                self_reads=frozenset(lf.self_reads),
+                self_writes=frozenset(lf.self_writes),
+                returns_param_taint=lf.returns_param_taint,
+            )
+            for line, col, display, reason in lf.blocking:
+                summ.blocking[(mod.path, line, col)] = (display, reason, ())
+            for line, col, display in lf.network_awaits:
+                summ.network_awaits[(mod.path, line, col)] = (display, ())
+            for needs, kind, line, col, msg in lf.taint_ops:
+                summ.taint_ops[(mod.path, line, col)] = (
+                    needs, kind, msg, ()
+                )
+            self.by_key[fn.key] = summ
+
+        # held locks at each call site, normalized, for awaits_held
+        held_at: Dict[str, Dict[tuple, FrozenSet[str]]] = {}
+        for fn in project.functions():
+            lf = self.locals.get(fn.key)
+            if lf is None:
+                continue
+            per_site: Dict[tuple, FrozenSet[str]] = {}
+            for line, col, raw_locks in lf.held_at_call:
+                per_site[(line, col)] = frozenset(
+                    x for x in (
+                        lock_identity(r, fn.class_name, fn.module, project)
+                        for r in raw_locks
+                    ) if x is not None
+                )
+            held_at[fn.key] = per_site
+
+        succ: Dict[str, List[str]] = {
+            key: sorted({e.callee.key for e in edges})
+            for key, edges in graph.edges.items()
+        }
+        sccs = _tarjan_sccs(sorted(self.by_key), succ)
+
+        for scc in sccs:
+            # members of one SCC iterate together until stable; a
+            # singleton without a self-loop stabilizes in one pass
+            for _ in range(len(scc) * 2 + 1):
+                changed = False
+                for key in scc:
+                    if self._merge_callees(key, held_at):
+                        changed = True
+                if not changed:
+                    break
+
+    def _merge_callees(
+        self, key: str, held_at: Dict[str, Dict[tuple, FrozenSet[str]]]
+    ) -> bool:
+        summ = self.by_key.get(key)
+        if summ is None:
+            return False
+        changed = False
+        for edge in self.graph.callees(key):
+            callee = self.by_key.get(edge.callee.key)
+            if callee is None:
+                continue
+            # a sync frame calling an async def only builds a coroutine
+            # object — nothing in the callee executes at this site
+            if callee.is_async and not summ.is_async:
+                continue
+            chain_step = (edge.callee.qualname,)
+            for site, (display, reason, chain) in callee.blocking.items():
+                if site not in summ.blocking:
+                    summ.blocking[site] = (
+                        display, reason, chain_step + chain
+                    )
+                    changed = True
+            for site, (display, chain) in callee.network_awaits.items():
+                if site not in summ.network_awaits:
+                    summ.network_awaits[site] = (
+                        display, chain_step + chain
+                    )
+                    changed = True
+            if not callee.acquires <= summ.acquires:
+                summ.acquires = summ.acquires | callee.acquires
+                changed = True
+            new_awaits_held = callee.awaits_held
+            if callee.may_suspend:
+                site_held = held_at.get(key, {}).get(
+                    (edge.node.lineno, edge.node.col_offset)
+                )
+                if site_held:
+                    new_awaits_held = new_awaits_held | site_held
+                if not summ.may_suspend:
+                    summ.may_suspend = True
+                    changed = True
+            if not new_awaits_held <= summ.awaits_held:
+                summ.awaits_held = summ.awaits_held | new_awaits_held
+                changed = True
+            if edge.via_self:
+                if not callee.self_reads <= summ.self_reads:
+                    summ.self_reads = summ.self_reads | callee.self_reads
+                    changed = True
+                if not callee.self_writes <= summ.self_writes:
+                    summ.self_writes = (
+                        summ.self_writes | callee.self_writes
+                    )
+                    changed = True
+            for site, (needs, kind, msg, chain) in callee.taint_ops.items():
+                if site not in summ.taint_ops:
+                    summ.taint_ops[site] = (
+                        needs, kind, msg, chain_step + chain
+                    )
+                    changed = True
+        return changed
+
+
+def get_summaries(project: Project) -> Summaries:
+    """Per-run memoized summaries: checkers share one fixpoint pass."""
+    summ = getattr(project, "_summaries", None)
+    if summ is None:
+        cached = getattr(project, "_cached_local_facts", None)
+        summ = Summaries(project, cached_locals=cached)
+        project._summaries = summ
+    return summ

@@ -334,6 +334,17 @@ class EdgeAggregator:
     def _creds(self) -> str:
         return f"client_id={self.client_id}&key={self.key}"
 
+    def _invalidate_credentials(self, stale_id: Optional[str]) -> None:
+        """Drop credentials observed to 401 — unless a handshake that
+        completed during the observing await already replaced them (the
+        401 then belonged to the OLD identity and the fresh credentials
+        must survive). The compare and the write run loop-atomically,
+        so this can never clobber an in-flight ``_register_with_root``
+        commit the way a blind ``self.client_id = None`` could."""
+        if stale_id is not None and self.client_id == stale_id:
+            # guarded by the compare above, not by _register_lock
+            self.client_id = None  # batonlint: allow[BTL004]
+
     async def _ensure_registered(self) -> None:
         if self.client_id is not None:
             return
@@ -376,14 +387,15 @@ class EdgeAggregator:
             await self._ensure_registered()
             return
         try:
+            cid = self.client_id
             with self.metrics.timer("heartbeat_s"):
                 async with self._session.get(
                     self.root_url + "heartbeat",
-                    json={"client_id": self.client_id, "key": self.key},
+                    json={"client_id": cid, "key": self.key},
                 ) as resp:
                     status = resp.status
             if status == 401:
-                self.client_id = None
+                self._invalidate_credentials(cid)
                 await self._ensure_registered()
         except (aiohttp.ClientError, asyncio.TimeoutError):
             pass  # next tick retries; workers fall back direct meanwhile
@@ -542,6 +554,7 @@ class EdgeAggregator:
             for attempt in range(max_attempts):
                 if self._closed:
                     break
+                cid = self.client_id
                 url = self.root_url + f"round_blob/{digest}?{self._creds()}"
                 headers = trace_headers()
                 if buf:
@@ -565,7 +578,7 @@ class EdgeAggregator:
                             self.metrics.inc("edge_blob_fetch_failed")
                             return None
                         elif resp.status == 401:
-                            self.client_id = None
+                            self._invalidate_credentials(cid)
                             await self._ensure_registered()
                             buf.clear()
                 except (aiohttp.ClientError, asyncio.TimeoutError):
@@ -858,10 +871,11 @@ class EdgeAggregator:
             return await self._proxy_update(client_id, body, content_type)
         try:
             # the only await between the snapshot and here is a
-            # return-await in the branch above; staleness is re-checked
-            # with the identity test right after this wait
+            # return-await in the branch above (branch-sensitive BTL003
+            # knows that path cannot fall through); staleness is
+            # re-checked with the identity test right after this wait
             await asyncio.wait_for(
-                r.template_ready.wait(), timeout=30.0  # batonlint: allow[BTL003]
+                r.template_ready.wait(), timeout=30.0
             )
         except asyncio.TimeoutError:
             return await self._proxy_update(client_id, body, content_type)
@@ -1184,6 +1198,7 @@ class EdgeAggregator:
             if self._closed:
                 return status
             await self._ensure_registered()
+            cid = self.client_id
             retry_after: Optional[float] = None
             chunked = (
                 self.upload_chunk_bytes is not None
@@ -1213,7 +1228,9 @@ class EdgeAggregator:
             if status in (200, 400, 409, 410, 413):
                 return status  # terminal either way
             if status == 401:
-                self.client_id = None  # root restarted: rejoin and retry
+                # root restarted: rejoin and retry (no-op if a parallel
+                # task already re-registered during our await)
+                self._invalidate_credentials(cid)
             delay = backoff * (0.5 + random.random() / 2)
             if retry_after is not None:
                 delay = max(delay, retry_after)

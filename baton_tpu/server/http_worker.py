@@ -812,17 +812,27 @@ class ExperimentWorker:
             return await self._handle_round_start_envelope(body)
         # legacy push protocol: the full round payload IS the body
         try:
-            tensors, meta = wire.decode_any(
-                body, request.content_type, allow_pickle=self.allow_pickle
-            )
+            content_type = request.content_type
+
+            def _decode_broadcast():
+                # CPU-bound decode (pickle/BTW1, possibly dequantize) of
+                # a model-sized body, off-loop like the manager's and
+                # edge's ingest decoders — heartbeats keep flowing while
+                # a multi-MB broadcast unpacks
+                tensors, meta = wire.decode_any(
+                    body, content_type, allow_pickle=self.allow_pickle
+                )
+                if meta.get("quantized"):
+                    # downlink-compressed broadcast (manager
+                    # broadcast_quantize_bits): reconstruct dense weights
+                    from baton_tpu.ops.compression import dequantize_state_dict
+
+                    tensors = dequantize_state_dict(tensors)
+                return tensors, meta
+
+            tensors, meta = await asyncio.to_thread(_decode_broadcast)
             round_name = meta["update_name"]
             n_epoch = int(meta["n_epoch"])
-            if meta.get("quantized"):
-                # downlink-compressed broadcast (manager
-                # broadcast_quantize_bits): reconstruct dense weights
-                from baton_tpu.ops.compression import dequantize_state_dict
-
-                tensors = dequantize_state_dict(tensors)
             new_params = state_dict_to_params(self.params, tensors)
         except Exception:
             # reject before mutating any state: a bad broadcast must not

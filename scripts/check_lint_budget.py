@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Lint wall-time budget + incremental-cache gate for CI.
+
+Runs batonlint twice over the same tree with a shared summary cache:
+
+  1. cold — empty cache, writes the JSON/SARIF artifacts CI uploads
+  2. warm — same invocation again; every per-file summary must come
+     out of ``.batonlint_cache.json`` (hits == files, misses == 0)
+
+and fails the job when either run exceeds its wall-time budget or the
+second run missed the cache. That pins two properties the fixpoint
+rewrite promised: the whole-program analysis stays cheap enough to run
+before the pytest budget, and the content-hash cache actually delivers
+incremental reruns instead of silently recomputing everything.
+
+Exit codes: 0 all gates pass, 1 a gate failed, 2 lint itself found
+problems or crashed (the lint step's own failure mode, surfaced as-is).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _run_lint(
+    paths: List[str],
+    json_out: pathlib.Path,
+    cache: pathlib.Path,
+    sarif: Optional[pathlib.Path],
+) -> float:
+    cmd = [
+        sys.executable,
+        "-m",
+        "baton_tpu.analysis",
+        *paths,
+        "--json-out",
+        str(json_out),
+        "--cache",
+        str(cache),
+    ]
+    if sarif is not None:
+        cmd += ["--sarif", str(sarif)]
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd)
+    elapsed = time.monotonic() - t0
+    if proc.returncode != 0:
+        print(
+            f"check_lint_budget: lint exited {proc.returncode}; "
+            "fix findings (or the crash) before gating on timing",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    return elapsed
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "paths", nargs="*", default=["baton_tpu"], help="lint targets"
+    )
+    parser.add_argument(
+        "--budget-seconds",
+        type=float,
+        default=60.0,
+        help="max wall time for the cold run (warm gets the same cap)",
+    )
+    parser.add_argument(
+        "--artifacts",
+        default="artifacts",
+        help="directory for batonlint-report.json / batonlint.sarif / "
+        "lint_budget.json",
+    )
+    args = parser.parse_args(argv)
+
+    art = pathlib.Path(args.artifacts)
+    art.mkdir(parents=True, exist_ok=True)
+    cache = art / "batonlint_cache.json"
+    if cache.exists():
+        cache.unlink()
+
+    cold_json = art / "batonlint-report.json"
+    warm_json = art / "batonlint-report-warm.json"
+    cold_s = _run_lint(args.paths, cold_json, cache, art / "batonlint.sarif")
+    warm_s = _run_lint(args.paths, warm_json, cache, None)
+
+    cold = json.loads(cold_json.read_text())
+    warm = json.loads(warm_json.read_text())
+    failures: List[str] = []
+    for label, elapsed in (("cold", cold_s), ("warm", warm_s)):
+        if elapsed > args.budget_seconds:
+            failures.append(
+                f"{label} lint run took {elapsed:.1f}s "
+                f"> budget {args.budget_seconds:.1f}s"
+            )
+    warm_cache = warm.get("cache") or {}
+    files = warm.get("files_checked", 0)
+    if warm_cache.get("misses", -1) != 0 or warm_cache.get("hits") != files:
+        failures.append(
+            "warm run did not come from cache: "
+            f"hits={warm_cache.get('hits')} misses={warm_cache.get('misses')} "
+            f"files={files}"
+        )
+
+    report = {
+        "budget_seconds": args.budget_seconds,
+        "cold_seconds": round(cold_s, 3),
+        "warm_seconds": round(warm_s, 3),
+        "files_checked": files,
+        "cold_cache": cold.get("cache"),
+        "warm_cache": warm_cache,
+        "failures": failures,
+    }
+    (art / "lint_budget.json").write_text(json.dumps(report, indent=2) + "\n")
+    print(
+        f"check_lint_budget: cold {cold_s:.1f}s, warm {warm_s:.1f}s "
+        f"(budget {args.budget_seconds:.0f}s), warm cache "
+        f"{warm_cache.get('hits')}/{files} hits"
+    )
+    for f in failures:
+        print(f"check_lint_budget: FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1342,8 +1342,9 @@ def test_real_metrics_registry_declares_compute_names():
 def test_all_rules_table():
     table = all_rules()
     assert set(table) == {
-        "BTL001", "BTL002", "BTL003", "BTL010", "BTL011", "BTL020",
-        "BTL030", "BTL031", "BTL032", "BTL033", "BTL034",
+        "BTL000", "BTL001", "BTL002", "BTL003", "BTL004", "BTL010",
+        "BTL011", "BTL020", "BTL030", "BTL031", "BTL032", "BTL033",
+        "BTL034",
     }
     assert all(table.values())
 
@@ -1428,6 +1429,720 @@ def test_cli_changed_only_smoke(tmp_path, capsys):
     if _git_changed_files() is not None:
         assert main(["--changed-only", str(bad)]) == 0
     capsys.readouterr()
+
+
+# ----------------------------------------------------------------------
+# fixpoint summaries: multi-hop reachability for BTL001/BTL002/BTL010
+
+
+def test_btl001_cross_module_two_hop_chain():
+    # the blocking call is TWO modules away from the async def; only the
+    # fixpoint summaries see it (the old same-module scan could not)
+    svc = """
+    from fixtures import store
+
+    async def flush(obj):
+        store.persist(obj)
+    """
+    store = """
+    from fixtures import disk
+
+    def persist(obj):
+        disk.write_obj(obj)
+    """
+    disk = """
+    import pickle
+
+    def write_obj(obj):
+        pickle.loads(obj)
+    """
+    findings = run_project_sources(
+        {
+            "fixtures/server/svc.py": textwrap.dedent(svc),
+            "fixtures/store.py": textwrap.dedent(store),
+            "fixtures/disk.py": textwrap.dedent(disk),
+        },
+        rules=["BTL001"],
+    )
+    assert len(findings) == 1
+    # the finding lands at the blocking SITE (in the non-server module)
+    assert findings[0].path == "fixtures/disk.py"
+    assert "via persist() -> write_obj()" in findings[0].message
+    assert "reached from `async def flush`" in findings[0].message
+
+
+def test_btl001_frozen_worker_inline_decode_regression():
+    # the EXACT pre-fix http_worker._handle_round_start_locked shape:
+    # the legacy-push broadcast body was decoded INLINE on the event
+    # loop through wire.decode_any (pickle.loads two hops away), while
+    # the manager and edge already routed the same decode through a
+    # pool thread
+    wirex = """
+    import pickle
+
+    def decode_any(body, content_type=None, allow_pickle=False):
+        return pickle.loads(body)
+    """
+    worker = """
+    from fixtures.server import wirex
+
+    class Worker:
+        async def handle_round_start(self, request, body):
+            tensors = wirex.decode_any(body, request.content_type)
+            return tensors
+    """
+    findings = run_project_sources(
+        {
+            "fixtures/server/wirex.py": textwrap.dedent(wirex),
+            "fixtures/server/worker.py": textwrap.dedent(worker),
+        },
+        rules=["BTL001"],
+    )
+    assert len(findings) == 1
+    assert "pickle.loads" in findings[0].message
+    assert "via decode_any()" in findings[0].message
+
+
+def test_btl001_fixed_worker_decode_shape_passes():
+    # the post-fix shape: decode wrapped in a closure handed to
+    # asyncio.to_thread — nested defs are off-loop by contract
+    wirex = """
+    import pickle
+
+    def decode_any(body, content_type=None, allow_pickle=False):
+        return pickle.loads(body)
+    """
+    worker = """
+    import asyncio
+    from fixtures.server import wirex
+
+    class Worker:
+        async def handle_round_start(self, request, body):
+            content_type = request.content_type
+
+            def _decode():
+                return wirex.decode_any(body, content_type)
+
+            return await asyncio.to_thread(_decode)
+    """
+    findings = run_project_sources(
+        {
+            "fixtures/server/wirex.py": textwrap.dedent(wirex),
+            "fixtures/server/worker.py": textwrap.dedent(worker),
+        },
+        rules=["BTL001"],
+    )
+    assert findings == []
+
+
+def test_btl002_subclass_override_lock_acquisition_caught():
+    # class-hierarchy analysis, both halves: the base method's
+    # `self._hook()` dispatches to the SUBCLASS override (which
+    # acquires the second lock), and `self._a_lock` in either class
+    # normalizes to the root ancestor, so the two sides of the ABBA
+    # pair unify on one lock identity
+    findings = lint(
+        """
+        import asyncio
+
+        class Base:
+            async def a_then_hook(self):
+                async with self._a_lock:
+                    await self._hook()
+
+            async def _hook(self):
+                pass
+
+        class Sub(Base):
+            async def _hook(self):
+                async with self._b_lock:
+                    pass
+
+            async def b_then_a(self):
+                async with self._b_lock:
+                    async with self._a_lock:
+                        pass
+        """,
+        rules=["BTL002"],
+    )
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "lock-order conflict" in msg
+    # identities unified at the root ancestor class
+    assert "Base._a_lock" in msg and "Base._b_lock" in msg
+
+
+def test_btl002_network_await_in_awaited_coroutine_under_lock():
+    # the held lock never appears in the callee: the hazard exists only
+    # through the callee's fixpoint summary
+    findings = lint(
+        """
+        import asyncio
+
+        class C:
+            async def _push(self, payload):
+                await self._session.post("u", json=payload)
+
+            async def commit(self, payload):
+                async with self._state_lock:
+                    await self._push(payload)
+        """,
+        rules=["BTL002"],
+    )
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "reached via C._push()" in msg
+    assert "self._session.post" in msg
+    # suppressible at the async-with header line too
+    assert findings[0].also_lines
+
+
+def test_btl002_awaited_coroutine_without_network_passes():
+    findings = lint(
+        """
+        import asyncio
+
+        class C:
+            async def _bump(self):
+                self._epoch += 1
+
+            async def commit(self):
+                async with self._state_lock:
+                    await self._bump()
+        """,
+        rules=["BTL002"],
+    )
+    assert findings == []
+
+
+def test_btl010_two_hop_taint_through_helpers():
+    # the cast sits two calls below the jitted function; the chain in
+    # the message names every hop
+    findings = lint(
+        """
+        import jax
+
+        def inner(v):
+            return float(v)
+
+        def outer(v):
+            return inner(v)
+
+        @jax.jit
+        def step(x):
+            return outer(x)
+        """,
+        path="baton_tpu/ops/fixture.py",
+        rules=["BTL010"],
+    )
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "via outer() -> inner()" in msg
+    assert "concretizes the tracer" in msg
+
+
+def test_btl010_helper_cast_needs_traced_argument():
+    # same helper, called with a static constant: no tracer crosses the
+    # call boundary, so the cast in the helper is NOT a hazard
+    findings = lint(
+        """
+        import jax
+
+        def scale(v):
+            return float(v)
+
+        @jax.jit
+        def step(x):
+            return x * scale(2)
+        """,
+        path="baton_tpu/ops/fixture.py",
+        rules=["BTL010"],
+    )
+    assert findings == []
+
+
+def test_btl010_print_in_helper_fires_without_taint():
+    # print runs at trace time regardless of what is passed in
+    findings = lint(
+        """
+        import jax
+
+        def log_step(n):
+            print("step", n)
+
+        @jax.jit
+        def step(x):
+            log_step(0)
+            return x
+        """,
+        path="baton_tpu/ops/fixture.py",
+        rules=["BTL010"],
+    )
+    assert len(findings) == 1
+    assert "via log_step()" in findings[0].message
+    assert "trace time only" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# BTL003 — branch sensitivity
+
+
+def test_btl003_staleness_on_terminating_branch_does_not_leak():
+    # the awaiting arm RETURNS: every execution that reaches the final
+    # write came down the suspension-free path, so the snapshot is
+    # loop-fresh there
+    findings = lint(
+        """
+        class W:
+            async def handler(self, request, name):
+                st = self._secure.get(name)
+                if request.fast_path:
+                    await request.drain()
+                    return None
+                st["shares"] = 1
+        """,
+        rules=["BTL003"],
+    )
+    assert findings == []
+
+
+def test_btl003_staleness_from_open_branch_still_flags():
+    # same shape minus the return: the awaiting arm falls through to
+    # the write, so one of the merged paths IS stale
+    findings = lint(
+        """
+        class W:
+            async def handler(self, request, name):
+                st = self._secure.get(name)
+                if request.fast_path:
+                    await request.drain()
+                st["shares"] = 1
+        """,
+        rules=["BTL003"],
+    )
+    assert len(findings) == 1
+    assert "snapshots `self._secure`" in findings[0].message
+
+
+def test_btl003_installed_guard_covers_later_awaits():
+    # an identity re-check whose failure arm raises IS the revalidation
+    # protocol for this snapshot; once installed, later awaits in the
+    # same function do not re-flag uses of the guarded name
+    findings = lint(
+        """
+        class W:
+            async def handler(self, request, name):
+                st = self._secure.get(name)
+                body = await request.read()
+                if self._secure.get(name) is not st:
+                    raise RuntimeError("round restarted")
+                st["a"] = body
+                more = await request.read()
+                st["b"] = more
+        """,
+        rules=["BTL003"],
+    )
+    assert findings == []
+
+
+def test_btl003_delegated_revalidation_through_helper():
+    # the identity re-check lives in a helper that compares its
+    # parameter against the shared source; passing the snapshot to it
+    # counts as revalidating
+    findings = lint(
+        """
+        class W:
+            def _still_current(self, st, name):
+                return self._secure.get(name) is st
+
+            async def handler(self, request, name):
+                st = self._secure.get(name)
+                body = await request.read()
+                if not self._still_current(st, name):
+                    return None
+                st["shares"] = body
+        """,
+        rules=["BTL003"],
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# BTL004 — async shared-state races
+
+
+def test_btl004_lost_update_window_flagged():
+    findings = lint(
+        """
+        class Manager:
+            async def add_waiter(self, w):
+                waiters = self._waiters
+                await self._flush()
+                self._waiters = waiters + [w]
+        """,
+        rules=["BTL004"],
+    )
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "lost-update window on `self._waiters`" in msg
+    assert "silently overwritten" in msg
+    # suppressible at the snapshot and the await too
+    assert findings[0].also_lines
+
+
+def test_btl004_reread_after_await_passes():
+    findings = lint(
+        """
+        class Manager:
+            async def add_waiter(self, w):
+                waiters = self._waiters
+                await self._flush()
+                waiters = self._waiters
+                self._waiters = waiters + [w]
+
+            async def add_in_place(self, w):
+                await self._flush()
+                self._waiters.append(w)
+        """,
+        rules=["BTL004"],
+    )
+    assert findings == []
+
+
+def test_btl004_identity_recheck_resets_lost_update():
+    findings = lint(
+        """
+        class Manager:
+            async def add_waiter(self, w):
+                waiters = self._waiters
+                await self._flush()
+                if waiters is self._waiters:
+                    self._waiters = waiters + [w]
+        """,
+        rules=["BTL004"],
+    )
+    assert findings == []
+
+
+def test_btl004_frozen_edge_blind_credential_drop_regression():
+    # the EXACT pre-fix edge._heartbeat_tick shape: registration writes
+    # self.client_id under _register_lock held across the handshake
+    # awaits; the 401 path blindly wrote None with no lock — clobbering
+    # a parallel handshake's freshly-committed credentials
+    findings = lint(
+        """
+        import asyncio
+
+        class Edge:
+            async def _register_with_root(self):
+                async with self._register_lock:
+                    async with self._session.get("register") as resp:
+                        data = await resp.json()
+                        self.client_id = data["client_id"]
+
+            async def _heartbeat_tick(self):
+                async with self._session.get("heartbeat") as resp:
+                    status = resp.status
+                if status == 401:
+                    self.client_id = None
+        """,
+        rules=["BTL004"],
+    )
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "`self.client_id` is written here without" in msg
+    assert "_register_lock" in msg
+    assert "compare-and-invalidate" in msg
+
+
+def test_btl004_compare_and_invalidate_fix_shape():
+    # the post-fix shape mirrored from server/edge.py: the 401 handler
+    # re-reads nothing blindly — it compares against the credentials
+    # the decision was based on, loop-atomically, and the one write
+    # that survives carries the audited allow (same as the repo)
+    report = Report()
+    findings = run_source(
+        textwrap.dedent(
+            """
+            import asyncio
+
+            class Edge:
+                async def _register_with_root(self):
+                    async with self._register_lock:
+                        async with self._session.get("register") as resp:
+                            data = await resp.json()
+                            self.client_id = data["client_id"]
+
+                def _invalidate_credentials(self, stale_id):
+                    if stale_id is not None and self.client_id == stale_id:
+                        self.client_id = None  # batonlint: allow[BTL004]
+
+                async def _heartbeat_tick(self):
+                    cid = self.client_id
+                    async with self._session.get("heartbeat") as resp:
+                        status = resp.status
+                    if status == 401:
+                        self._invalidate_credentials(cid)
+            """
+        ),
+        path=SERVER_PATH,
+        rules=["BTL004"],
+        report=report,
+    )
+    assert findings == []
+    assert report.suppressed == 1
+
+
+def test_btl004_writes_under_the_lock_pass():
+    findings = lint(
+        """
+        import asyncio
+
+        class Edge:
+            async def _register_with_root(self):
+                async with self._register_lock:
+                    async with self._session.get("register") as resp:
+                        data = await resp.json()
+                        self.client_id = data["client_id"]
+
+            async def _drop(self):
+                async with self._register_lock:
+                    self.client_id = None
+
+            def __init__(self):
+                self.client_id = None
+        """,
+        rules=["BTL004"],
+    )
+    assert findings == []
+
+
+def test_btl004_scoped_to_server_paths():
+    src = """
+    class M:
+        async def f(self, w):
+            waiters = self._waiters
+            await self._flush()
+            self._waiters = waiters + [w]
+    """
+    assert lint(src, rules=["BTL004"]) != []
+    assert lint(src, path="baton_tpu/ops/fixture.py", rules=["BTL004"]) == []
+
+
+# ----------------------------------------------------------------------
+# BTL000 — stale suppressions
+
+
+def test_btl000_stale_named_allow_flagged():
+    findings = lint(
+        """
+        x = 1  # batonlint: allow[BTL020]
+        """,
+        rules=["BTL000", "BTL020"],
+    )
+    assert rules_of(findings) == ["BTL000"]
+    assert "allow[BTL020]" in findings[0].message
+    assert "no longer fires here" in findings[0].message
+
+
+def test_btl000_used_allow_is_not_stale():
+    report = Report()
+    findings = run_source(
+        textwrap.dedent(
+            """
+            async def f(request):
+                return await request.read()  # batonlint: allow[BTL020]
+            """
+        ),
+        path=SERVER_PATH,
+        rules=["BTL000", "BTL020"],
+        report=report,
+    )
+    assert findings == []
+    assert report.suppressed == 1
+
+
+def test_btl000_stale_wildcard_flagged():
+    findings = lint(
+        """
+        y = 2  # batonlint: allow[*]
+        """,
+        rules=["BTL000", "BTL020"],
+    )
+    assert rules_of(findings) == ["BTL000"]
+    assert "allow[*]" in findings[0].message
+
+
+def test_btl000_docstring_mention_is_not_a_suppression():
+    # allow[...] in prose (docstrings, strings) is neither a working
+    # suppression nor a stale one — only real comment tokens count
+    findings = lint(
+        '''
+        def f():
+            """Suppress with ``# batonlint: allow[BTL020]`` if needed."""
+            return 1
+        ''',
+        rules=["BTL000", "BTL020"],
+    )
+    assert findings == []
+
+
+def test_btl000_not_audited_when_rule_not_selected():
+    # the allow targets a rule that did not run this pass: no verdict
+    findings = lint(
+        """
+        x = 1  # batonlint: allow[BTL020]
+        """,
+        rules=["BTL000", "BTL030"],
+    )
+    assert findings == []
+
+
+def test_btl000_escape_hatch_allows_itself():
+    findings = lint(
+        """
+        x = 1  # batonlint: allow[BTL020,BTL000]
+        """,
+        rules=["BTL000", "BTL020"],
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# incremental summary cache
+
+
+def test_summary_cache_cold_warm_and_invalidation(tmp_path):
+    server = tmp_path / "server"
+    server.mkdir()
+    a = server / "a.py"
+    b = server / "b.py"
+    a.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    b.write_text("def g():\n    return 1\n")
+    cache = tmp_path / "cache.json"
+
+    cold = run_paths([str(tmp_path)], cache_path=str(cache))
+    assert (cold.cache_hits, cold.cache_misses) == (0, 2)
+    assert len(cold.findings) == 1
+
+    warm = run_paths([str(tmp_path)], cache_path=str(cache))
+    assert (warm.cache_hits, warm.cache_misses) == (2, 0)
+    # cached local facts feed the same fixpoint: identical findings
+    assert [
+        (f.rule, f.path, f.line) for f in warm.findings
+    ] == [(f.rule, f.path, f.line) for f in cold.findings]
+
+    # edit one file: only that file re-extracts
+    b.write_text("def g():\n    return 2\n")
+    mixed = run_paths([str(tmp_path)], cache_path=str(cache))
+    assert (mixed.cache_hits, mixed.cache_misses) == (1, 1)
+
+
+def test_summary_cache_corrupt_file_is_a_miss(tmp_path):
+    server = tmp_path / "server"
+    server.mkdir()
+    (server / "a.py").write_text("def g():\n    return 1\n")
+    cache = tmp_path / "cache.json"
+    cache.write_text("{not json")
+    report = run_paths([str(tmp_path)], cache_path=str(cache))
+    assert (report.cache_hits, report.cache_misses) == (0, 1)
+    # and the run repaired the cache file
+    warm = run_paths([str(tmp_path)], cache_path=str(cache))
+    assert (warm.cache_hits, warm.cache_misses) == (1, 0)
+
+
+def test_cli_cache_stats_in_json_out(tmp_path, capsys):
+    import json as _json
+
+    from baton_tpu.analysis.__main__ import main
+
+    server = tmp_path / "server"
+    server.mkdir()
+    (server / "a.py").write_text("def g():\n    return 1\n")
+    out = tmp_path / "report.json"
+    cache = tmp_path / "cache.json"
+    assert main(["--cache", str(cache), "--json-out", str(out),
+                 str(tmp_path)]) == 0
+    assert _json.loads(out.read_text())["cache"] == {
+        "hits": 0, "misses": 1,
+    }
+    assert main(["--cache", str(cache), "--json-out", str(out),
+                 str(tmp_path)]) == 0
+    assert _json.loads(out.read_text())["cache"] == {
+        "hits": 1, "misses": 0,
+    }
+    capsys.readouterr()
+
+
+# ----------------------------------------------------------------------
+# SARIF reporter
+
+
+def test_sarif_document_structure():
+    from baton_tpu.analysis.sarif import SARIF_SCHEMA, sarif_dict
+
+    report = Report()
+    run_source(
+        "async def f(request):\n    return await request.read()\n",
+        path="baton_tpu/server/bad.py",
+        report=report,
+    )
+    doc = sarif_dict(report)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"] == SARIF_SCHEMA
+    assert len(doc["runs"]) == 1
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "batonlint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert rule_ids == set(all_rules())
+    assert all(
+        r["shortDescription"]["text"] for r in driver["rules"]
+    )
+    assert run["invocations"][0]["executionSuccessful"] is True
+    assert len(run["results"]) == 1
+    res = run["results"][0]
+    assert res["ruleId"] == "BTL020"
+    assert res["ruleId"] in rule_ids
+    assert res["level"] == "warning"
+    assert res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "baton_tpu/server/bad.py"
+    assert loc["artifactLocation"]["uriBaseId"] in run["originalUriBaseIds"]
+    assert loc["region"]["startLine"] == 2
+    assert loc["region"]["startColumn"] >= 1  # SARIF columns are 1-based
+
+
+def test_sarif_errors_become_notifications():
+    from baton_tpu.analysis.sarif import sarif_dict
+
+    report = Report()
+    run_source("def broken(:", path="x.py", report=report)
+    doc = sarif_dict(report)
+    inv = doc["runs"][0]["invocations"][0]
+    assert inv["executionSuccessful"] is False
+    notes = inv["toolExecutionNotifications"]
+    assert len(notes) == 1
+    assert notes[0]["level"] == "error"
+    assert "syntax error" in notes[0]["message"]["text"]
+
+
+def test_cli_sarif_writes_valid_json(tmp_path, capsys):
+    import json as _json
+
+    from baton_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "server" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "async def f(request):\n    return await request.read()\n"
+    )
+    out = tmp_path / "report.sarif"
+    assert main(["--sarif", str(out), str(bad)]) == 1
+    capsys.readouterr()
+    doc = _json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"][0]["ruleId"] == "BTL020"
 
 
 # ----------------------------------------------------------------------
