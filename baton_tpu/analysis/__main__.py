@@ -7,6 +7,7 @@ fail the build on any finding while distinguishing broken invocations.
 from __future__ import annotations
 
 import argparse
+import json
 import pathlib
 import subprocess
 import sys
@@ -14,10 +15,31 @@ from typing import List, Optional
 
 from baton_tpu.analysis.engine import (
     all_rules,
+    apply_baseline,
+    finding_fingerprints,
     format_json,
     format_text,
     run_paths,
 )
+
+
+def _load_baseline(path: str) -> Optional[List[str]]:
+    """Committed baseline fingerprints: ``{"version": 1,
+    "fingerprints": [...]}`` (a bare JSON list is also accepted);
+    None on unreadable/malformed input."""
+    try:
+        data = json.loads(
+            pathlib.Path(path).read_text(encoding="utf-8")
+        )
+    except (OSError, ValueError):
+        return None
+    if isinstance(data, list):
+        return [str(x) for x in data]
+    if isinstance(data, dict) and isinstance(
+        data.get("fingerprints"), list
+    ):
+        return [str(x) for x in data["fingerprints"]]
+    return None
 
 
 def _git_changed_files() -> Optional[List[str]]:
@@ -103,6 +125,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="additionally write a SARIF 2.1.0 report to FILE",
     )
     parser.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help=(
+            "fail only on findings whose fingerprint is absent from "
+            "this committed baseline (see --write-baseline); "
+            "baselined findings are counted, not printed"
+        ),
+    )
+    parser.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        help=(
+            "write the current findings' fingerprints to FILE and "
+            "exit 0 — the debt snapshot --baseline diffs against"
+        ),
+    )
+    parser.add_argument(
         "--cache",
         metavar="FILE",
         nargs="?",
@@ -138,6 +177,39 @@ def main(argv: Optional[List[str]] = None) -> int:
     except KeyError as exc:
         print(f"batonlint: {exc.args[0]}", file=sys.stderr)
         return 2
+
+    if args.write_baseline:
+        payload = {
+            "version": 1,
+            "fingerprints": sorted(
+                finding_fingerprints(report.findings)
+            ),
+        }
+        try:
+            pathlib.Path(args.write_baseline).write_text(
+                json.dumps(payload, indent=2) + "\n", encoding="utf-8"
+            )
+        except OSError as exc:
+            print(
+                f"batonlint: cannot write {args.write_baseline}: {exc}",
+                file=sys.stderr,
+            )
+            return 2
+        print(
+            f"batonlint: baseline of {len(report.findings)} "
+            f"fingerprint(s) written to {args.write_baseline}"
+        )
+        return 0
+
+    if args.baseline:
+        fingerprints = _load_baseline(args.baseline)
+        if fingerprints is None:
+            print(
+                f"batonlint: unreadable baseline {args.baseline}",
+                file=sys.stderr,
+            )
+            return 2
+        apply_baseline(report, fingerprints)
 
     if args.json_out:
         try:

@@ -11,10 +11,14 @@ can report the path a hazard travels, not just its endpoints; a call
 site with several dispatch candidates contributes one edge per
 candidate.
 
-Calls the resolver cannot pin down (``getattr``, HOFs, calls through
-arbitrary objects) are simply absent, so rules built on the graph
-UNDER-report across those boundaries and say so in their docs rather
-than guessing.
+Reflection calls the resolver CAN pin down also contribute edges:
+``getattr(self, "handle_" + x)(...)`` fans out to every hierarchy
+method matching the literal prefix, and dict-literal dispatch tables
+(function-local, ``self.X``, or module-level) fan ``tbl[k](...)`` /
+``tbl.get(k)(...)`` out to every table value.  Calls that remain
+dynamic (computed names with no literal prefix, HOFs through opaque
+objects) are simply absent, so rules built on the graph UNDER-report
+across those boundaries and say so in their docs rather than guessing.
 """
 
 from __future__ import annotations
@@ -45,6 +49,23 @@ def _is_self_call(call: ast.Call) -> bool:
     )
 
 
+def _local_dispatch_tables(fn_node: ast.AST) -> Dict[str, Tuple[str, ...]]:
+    """Function-local ``tbl = {k: handler, ...}`` dispatch tables."""
+    from baton_tpu.analysis.project import _dict_literal_refs
+
+    out: Dict[str, Tuple[str, ...]] = {}
+    for node in au.walk_shallow(fn_node):
+        if not isinstance(node, ast.Assign):
+            continue
+        refs = _dict_literal_refs(node.value)
+        if refs is None:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.setdefault(t.id, refs)
+    return out
+
+
 @dataclasses.dataclass
 class CallEdge:
     caller: FunctionInfo
@@ -68,16 +89,25 @@ class CallGraph:
         self.edges: Dict[str, List[CallEdge]] = {}
         for fn in project.functions():
             out: List[CallEdge] = []
+            local_tables = _local_dispatch_tables(fn.node)
             for node in au.walk_shallow(fn.node):
                 if not isinstance(node, ast.Call):
                     continue
+                seen_here: set = set()
                 for callee in project.resolve_call_multi(
                     fn.module, fn.class_name, node
                 ):
                     if callee.key != fn.key:
+                        seen_here.add(callee.key)
                         out.append(
                             CallEdge(fn, callee, node, _is_self_call(node))
                         )
+                for callee, via_self in project.reflection_targets(
+                    fn.module, fn.class_name, node, local_tables
+                ):
+                    if callee.key != fn.key and callee.key not in seen_here:
+                        seen_here.add(callee.key)
+                        out.append(CallEdge(fn, callee, node, via_self))
             self.edges[fn.key] = out
 
     def callees(self, key: str) -> List[CallEdge]:

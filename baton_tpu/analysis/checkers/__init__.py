@@ -11,7 +11,9 @@ observability) and add known-bad/known-good fixtures to
 from baton_tpu.analysis.checkers import (  # noqa: F401
     alertrules,
     blocking,
+    contexts,
     counters,
+    deadcode,
     donation,
     exemplars,
     locks,

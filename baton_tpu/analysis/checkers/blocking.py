@@ -24,6 +24,14 @@ routing calls themselves are awaits, and a sync frame merely *calling*
 an ``async def`` (no await possible) only builds a coroutine, so
 nothing behind it is considered reached.
 
+The rule is execution-context sensitive (third pass): a SYNC function
+whose context witness roots it on the event loop through a
+registration — a ``PeriodicTask`` callback, ``loop.call_soon`` /
+``add_done_callback`` target, or a sync route handler — is held to the
+same standard as an ``async def``, while a function dispatched only to
+worker threads (``to_thread`` / executor ``submit``) may legally block
+and is never flagged.
+
 The blocked-primitive tables live in
 :mod:`baton_tpu.analysis.summaries` (the summary extraction records
 the sites); this module owns the reachability policy and reporting.
@@ -99,4 +107,38 @@ class BlockingCallChecker(ProjectChecker):
                             also_lines=also,
                         )
                     )
+        # context pass: sync functions the entry-point model roots on
+        # the event loop through a REGISTRATION (PeriodicTask, loop
+        # callbacks, sync route handlers) block the loop exactly like
+        # an async def body; thread-only functions legally block and
+        # are exempt by construction (no loop witness).
+        seen_sites = {(f.path, f.line, f.col) for f in findings}
+        for fn in project.functions():
+            if isinstance(fn.node, ast.AsyncFunctionDef):
+                continue
+            lf = summaries.locals.get(fn.key)
+            if lf is None or not lf.blocking:
+                continue
+            w = summaries.witness(fn.key, "loop")
+            if w is None or w.seed == "async" or not w.server:
+                continue
+            hop = (
+                " -> ".join(f"{q}()" for q in (w.root_qual,) + w.chain)
+                if w.chain else f"{w.root_qual}()"
+            )
+            also = (
+                (w.reg_line,) if w.reg_path == fn.module.path else ()
+            )
+            for line, col, _display, reason in lf.blocking:
+                if (fn.module.path, line, col) in seen_sites:
+                    continue
+                findings.append(
+                    Finding(
+                        self.rule, fn.module.path, line, col,
+                        f"{reason} (in `{fn.qualname}`, which runs on "
+                        f"the event loop: {hop} {w.reason})"
+                        + _ROUTE_HINT,
+                        also_lines=also,
+                    )
+                )
         return findings

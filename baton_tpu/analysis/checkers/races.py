@@ -39,6 +39,14 @@ may live in a transitive callee) holds ``L`` across a suspension.
 the degenerate single-method case (writer and only accessor are the
 same code under the same lock).
 
+Lost-update scanning is **loop-sensitive**: a ``for``/``while``/
+``async for`` whose body suspends is visited twice, the second pass
+entering with the state the first pass left and every live snapshot
+marked stale at the loop header — a snapshot hoisted above the loop is
+fresh on iteration 1 but every later iteration writes back through a
+value from a previous epoch.  Findings surfaced only by the repass
+carry loop-carried wording.
+
 Scope: classes in ``server/`` modules, asyncio only — ``threading``
 locks (``with``, not ``async with``) guard true parallelism and are a
 different rule's business.  Lock identities unify through the class
@@ -61,6 +69,20 @@ from baton_tpu.analysis.summaries import get_summaries, lock_identity
 _FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
 _SUSPENDERS = (ast.Await, ast.AsyncFor)
 _CTOR_NAMES = {"__init__", "__post_init__", "__set_name__"}
+
+
+def _body_suspends(stmts: List[ast.stmt]) -> bool:
+    """True when a loop body can suspend the task (await / async for /
+    async with anywhere in it, nested functions excluded)."""
+    todo: List[ast.AST] = list(stmts)
+    while todo:
+        n = todo.pop()
+        if isinstance(n, _FUNCS):
+            continue
+        if isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return True
+        todo.extend(ast.iter_child_nodes(n))
+    return False
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:
@@ -249,6 +271,8 @@ class AsyncRaceChecker(ProjectChecker):
         self, fn, class_name, mod, project, findings
     ) -> None:
         snapshots: Dict[str, _Snapshot] = {}
+        loop_repass = [0]
+        flagged_sites: Set[Tuple[int, int, str]] = set()
 
         def lock_of(expr) -> Optional[str]:
             return lock_identity(expr, class_name, mod, project)
@@ -314,6 +338,16 @@ class AsyncRaceChecker(ProjectChecker):
 
         def flag(name: str, snap: _Snapshot, stmt) -> None:
             snap.dead = True
+            site = (stmt.lineno, stmt.col_offset, name)
+            if site in flagged_sites:
+                return  # already reported on an earlier loop pass
+            flagged_sites.add(site)
+            carried = (
+                " (loop-carried: the snapshot is taken once but the "
+                "loop body suspends, so every iteration after the "
+                "first writes back through a stale value)"
+                if loop_repass[0] else ""
+            )
             findings.append(
                 Finding(
                     self.rule, mod.path, stmt.lineno, stmt.col_offset,
@@ -325,7 +359,7 @@ class AsyncRaceChecker(ProjectChecker):
                     f"`{name}` — a concurrent task's update during the "
                     f"suspension is silently overwritten; re-read "
                     f"`self.{snap.attr}` after the await (or mutate it "
-                    f"in place / guard the window with a lock)",
+                    f"in place / guard the window with a lock)" + carried,
                     also_lines=tuple(
                         x for x in (snap.line, snap.stale_since)
                         if x is not None
@@ -400,6 +434,24 @@ class AsyncRaceChecker(ProjectChecker):
                         if lid is not None:
                             new_held = new_held | {lid}
                     visit(stmt.body, new_held)
+                    continue
+                # loops whose body suspends: repass with iteration 1's
+                # end state — a snapshot hoisted above the loop feeds a
+                # repeated lost-update window on iterations 2+
+                if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                    visit(stmt.body, held)
+                    if isinstance(stmt, ast.AsyncFor) or _body_suspends(
+                        stmt.body
+                    ):
+                        for snap in snapshots.values():
+                            if not snap.dead and snap.stale_since is None:
+                                snap.stale_since = stmt.lineno
+                        loop_repass[0] += 1
+                        try:
+                            visit(stmt.body, held)
+                        finally:
+                            loop_repass[0] -= 1
+                    visit(stmt.orelse, held)
                     continue
                 for block in (
                     getattr(stmt, "body", None),

@@ -46,9 +46,16 @@ arm that *terminates* (ends in ``return``/``raise``/``continue``/
 ``break``) does not leak its staleness into the fall-through path — so
 a guard like ``if cached: return await self._proxy(...)`` no longer
 poisons the straight-line code after it, and a re-check that returns
-on mismatch validates the surviving path.  Loops and ``try`` bodies
-are still visited sequentially (their effects union), so genuinely-
-safe hits there may need a justified ``# batonlint: allow[BTL003]``.
+on mismatch validates the surviving path.
+
+Loops are **loop-sensitive**: a ``for``/``while``/``async for`` whose
+body suspends is visited twice, the second pass entering with the
+state the first pass left — so a snapshot hoisted ABOVE the loop is
+correctly stale on every iteration after the first, even when each
+single iteration reads the name before its own await.  Findings from
+the repass carry loop-carried wording.  ``try`` bodies still visit
+sequentially (effects union), so genuinely-safe hits there may need a
+justified ``# batonlint: allow[BTL003]``.
 
 Scope: ``async def``s under ``server/`` only.
 """
@@ -252,6 +259,19 @@ def _terminates(block: List[ast.stmt]) -> bool:
     return bool(block) and isinstance(block[-1], _TERMINATORS)
 
 
+def _block_suspends(stmts: List[ast.stmt]) -> bool:
+    """The block contains a suspension point outside nested defs."""
+    todo: List[ast.AST] = list(stmts)
+    while todo:
+        n = todo.pop()
+        if isinstance(n, _FUNCS):
+            continue
+        if isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return True
+        todo.extend(ast.iter_child_nodes(n))
+    return False
+
+
 @register
 class StaleSnapshotChecker(Checker):
     rule = "BTL003"
@@ -279,8 +299,21 @@ class StaleSnapshotChecker(Checker):
         self, fn, cls, attrs, helper_sources, revalidators, findings, ctx
     ) -> None:
 
+        loop_repass = [0]
+        flagged_sites: Set[Tuple[int, int, str]] = set()
+
         def flag(name: str, tr: _Tracked, node: ast.AST) -> None:
             tr.dead = True
+            site = (node.lineno, node.col_offset, name)
+            if site in flagged_sites:
+                return  # already reported on an earlier loop pass
+            flagged_sites.add(site)
+            carried = (
+                " (loop-carried: the snapshot is taken once but the "
+                "loop body suspends, so every iteration after the "
+                "first acts on a stale value)"
+                if loop_repass[0] else ""
+            )
             findings.append(
                 Finding(
                     self.rule, ctx.path, node.lineno, node.col_offset,
@@ -289,7 +322,7 @@ class StaleSnapshotChecker(Checker):
                     f"{tr.pending_since}: the registry may have been "
                     f"re-keyed during the suspension — re-read it or "
                     f"identity-check (`{tr.source} ... is {name}`) "
-                    f"before trusting the snapshot",
+                    f"before trusting the snapshot" + carried,
                     also_lines=tuple(
                         x for x in (tr.line, tr.pending_since)
                         if x is not None
@@ -299,6 +332,10 @@ class StaleSnapshotChecker(Checker):
 
         def flag_same_stmt(name: str, tr: _Tracked, node: ast.AST) -> None:
             tr.dead = True
+            site = (node.lineno, node.col_offset, name)
+            if site in flagged_sites:
+                return
+            flagged_sites.add(site)
             findings.append(
                 Finding(
                     self.rule, ctx.path, node.lineno, node.col_offset,
@@ -601,6 +638,27 @@ class StaleSnapshotChecker(Checker):
                     merged = merge(arms)
                     tracked.clear()
                     tracked.update(merged)
+                    continue
+                # loops whose body suspends: visit the body a second
+                # time with iteration 1's end state — a snapshot hoisted
+                # above the loop is fresh on iteration 1 but stale on
+                # every later one (the repass marks it pending at the
+                # loop header and re-runs the body with loop-carried
+                # wording)
+                if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                    visit(stmt.body, tracked)
+                    if isinstance(stmt, ast.AsyncFor) or _block_suspends(
+                        stmt.body
+                    ):
+                        for tr in tracked.values():
+                            if not tr.dead and tr.pending_since is None:
+                                tr.pending_since = stmt.lineno
+                        loop_repass[0] += 1
+                        try:
+                            visit(stmt.body, tracked)
+                        finally:
+                            loop_repass[0] -= 1
+                    visit(stmt.orelse, tracked)
                     continue
                 for block in (
                     getattr(stmt, "body", None),

@@ -23,6 +23,8 @@ __all__ = [
     "ProjectChecker",
     "Report",
     "all_rules",
+    "apply_baseline",
+    "finding_fingerprints",
     "register",
     "run_paths",
     "run_project_sources",
@@ -73,6 +75,8 @@ class Report:
     # hits = files whose per-function facts were reused by content hash
     cache_hits: int = 0
     cache_misses: int = 0
+    # findings absorbed by --baseline (still real; just pre-existing)
+    baselined: int = 0
 
     @property
     def clean(self) -> bool:
@@ -584,7 +588,55 @@ def _parse_counter_registry(
     }
 
 
-CACHE_VERSION = 1
+# -- finding fingerprints / baseline -----------------------------------
+def _fingerprint_base(f: Finding) -> str:
+    """Location-independent identity of a finding: rule + posix path +
+    the message with every digit run collapsed — stable across pure
+    line-number drift (the property SARIF ``partialFingerprints`` and
+    ``--baseline`` need), while a finding MOVING to another file or
+    changing meaning gets a new identity."""
+    import hashlib
+
+    norm_msg = re.sub(r"\d+", "#", f.message)
+    posix = pathlib.PurePath(f.path).as_posix()
+    return hashlib.sha256(
+        f"{f.rule}\x00{posix}\x00{norm_msg}".encode("utf-8")
+    ).hexdigest()[:16]
+
+
+def finding_fingerprints(findings: Sequence[Finding]) -> List[str]:
+    """One stable fingerprint per finding, order-aligned with the
+    input.  Identical findings in one report are disambiguated with an
+    ``:N`` occurrence suffix, so a report with three instances of the
+    same hazard baselines exactly three — a fourth still fails."""
+    counts: Dict[str, int] = {}
+    out: List[str] = []
+    for f in findings:
+        base = _fingerprint_base(f)
+        n = counts.get(base, 0)
+        counts[base] = n + 1
+        out.append(base if n == 0 else f"{base}:{n}")
+    return out
+
+
+def apply_baseline(report: Report, fingerprints) -> None:
+    """Drop findings whose fingerprint appears in ``fingerprints``
+    (a committed baseline); the drop count lands on
+    ``report.baselined``.  New findings — absent from the baseline —
+    survive and still fail the run."""
+    known = frozenset(fingerprints)
+    keep: List[Finding] = []
+    dropped = 0
+    for f, fp in zip(report.findings, finding_fingerprints(report.findings)):
+        if fp in known:
+            dropped += 1
+        else:
+            keep.append(f)
+    report.findings[:] = keep
+    report.baselined += dropped
+
+
+CACHE_VERSION = 2  # v2: LocalFacts gained execution-context fields
 
 
 def _load_summary_cache(cache_path: str, entries) -> Dict[str, dict]:
@@ -713,21 +765,31 @@ def format_text(report: Report) -> str:
     ]
     for err in report.errors:
         lines.append(f"error: {err}")
+    baseline_note = (
+        f", {report.baselined} baselined" if report.baselined else ""
+    )
     lines.append(
         f"batonlint: {len(report.findings)} finding(s), "
-        f"{report.suppressed} suppressed, "
+        f"{report.suppressed} suppressed{baseline_note}, "
         f"{report.files_checked} file(s) checked"
     )
     return "\n".join(lines)
 
 
 def format_json(report: Report) -> str:
+    fps = finding_fingerprints(report.findings)
+    findings = []
+    for f, fp in zip(report.findings, fps):
+        rec = f.to_json()
+        rec["fingerprint"] = fp
+        findings.append(rec)
     return json.dumps(
         {
-            "findings": [f.to_json() for f in report.findings],
+            "findings": findings,
             "suppressed": report.suppressed,
             "files_checked": report.files_checked,
             "errors": list(report.errors),
+            "baselined": report.baselined,
             "cache": {
                 "hits": report.cache_hits,
                 "misses": report.cache_misses,

@@ -25,9 +25,18 @@ contract as the rest of batonlint):
   chain PLUS every override in known subclasses (class-hierarchy
   analysis — the receiver's dynamic type may be any subclass of the
   enclosing class), and ``super().method()`` resolves to the nearest
-  base-class definition.  Re-exports and true dynamic dispatch
-  (``getattr``, HOFs) remain out of scope — a resolver miss returns
-  ``None``/``[]`` and the caller degrades to per-file behavior.
+  base-class definition;
+* the common reflection idioms resolve too:
+  ``getattr(self, "handle_" + x)(...)`` (and the f-string spelling)
+  dispatches to every method of the class hierarchy whose name starts
+  with the literal prefix, and dict-literal dispatch tables —
+  function-local ``tbl = {...}``, instance ``self._table = {...}``,
+  or module-level ``TABLE = {...}`` whose values are resolvable
+  callable references — dispatch ``tbl[k](...)`` / ``tbl.get(k)(...)``
+  to every value.  Truly dynamic dispatch (computed attribute names
+  with no literal prefix, HOFs through opaque objects) remains out of
+  scope — a resolver miss returns ``None``/``[]`` and the caller
+  degrades to per-file behavior.
 """
 
 from __future__ import annotations
@@ -59,6 +68,46 @@ class FunctionInfo:
     @property
     def is_async(self) -> bool:
         return isinstance(self.node, ast.AsyncFunctionDef)
+
+
+def _dict_literal_refs(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Values of a dict literal as dotted callable refs, when EVERY
+    non-constant value is one — the dispatch-table shape.  Returns None
+    for anything else (a dict of data is not a dispatch table)."""
+    if not isinstance(node, ast.Dict) or not node.values:
+        return None
+    refs = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            return None
+        d = au.dotted_name(v)
+        if d is None:
+            return None
+        refs.append(d)
+    return tuple(refs)
+
+
+def _str_pattern(node: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    """``(exact, prefix)`` for the attribute-name expression of a
+    ``getattr`` call: a string constant gives ``exact``; ``"pre_" + x``
+    and ``f"pre_{x}"`` give ``prefix``; anything else ``(None, None)``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, None
+    if (
+        isinstance(node, ast.BinOp)
+        and isinstance(node.op, ast.Add)
+        and isinstance(node.left, ast.Constant)
+        and isinstance(node.left.value, str)
+    ):
+        return None, node.left.value
+    if (
+        isinstance(node, ast.JoinedStr)
+        and node.values
+        and isinstance(node.values[0], ast.Constant)
+        and isinstance(node.values[0].value, str)
+    ):
+        return None, node.values[0].value
+    return None, None
 
 
 @dataclasses.dataclass
@@ -114,6 +163,82 @@ class ModuleInfo:
                 self.classes.setdefault(
                     node.name, ClassInfo(node.name, self, node, bases)
                 )
+        self._global_names: Optional[frozenset] = None
+        self._dispatch_tables: Optional[Dict[str, Tuple[str, ...]]] = None
+        self._class_tables: Optional[
+            Dict[Tuple[str, str], Tuple[str, ...]]
+        ] = None
+
+    @property
+    def global_names(self) -> frozenset:
+        """Module-level mutable bindings: names assigned at module scope
+        that are not imports, defs, or classes — the state a worker
+        thread and the event loop could race on."""
+        if self._global_names is None:
+            bound: set = set()
+            for stmt in self.tree.body:
+                targets: list = []
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [stmt.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        bound.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        bound.update(
+                            e.id for e in t.elts if isinstance(e, ast.Name)
+                        )
+            self._global_names = frozenset(
+                bound - set(self.imports) - set(self.functions)
+                - set(self.classes)
+            )
+        return self._global_names
+
+    @property
+    def dispatch_tables(self) -> Dict[str, Tuple[str, ...]]:
+        """Module-level ``NAME = {k: handler, ...}`` dict literals whose
+        values are callable refs — ``{NAME: (ref, ...)}``."""
+        if self._dispatch_tables is None:
+            out: Dict[str, Tuple[str, ...]] = {}
+            for stmt in self.tree.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                refs = _dict_literal_refs(stmt.value)
+                if refs is None:
+                    continue
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.setdefault(t.id, refs)
+            self._dispatch_tables = out
+        return self._dispatch_tables
+
+    @property
+    def class_dispatch_tables(self) -> Dict[Tuple[str, str], Tuple[str, ...]]:
+        """``self.X = {k: self.handler, ...}`` tables assigned in any
+        method — ``{(class_name, attr): (ref, ...)}``."""
+        if self._class_tables is None:
+            out: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+            for fi in self.functions.values():
+                if fi.class_name is None:
+                    continue
+                for node in au.walk_shallow(fi.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    refs = _dict_literal_refs(node.value)
+                    if refs is None:
+                        continue
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id in ("self", "cls")
+                        ):
+                            out.setdefault(
+                                (fi.class_name, t.attr), refs
+                            )
+            self._class_tables = out
+        return self._class_tables
 
 
 def _module_name_for(path: str) -> str:
@@ -438,3 +563,174 @@ class Project:
 
     def imports_target(self, mod: ModuleInfo, alias: str) -> Optional[str]:
         return mod.imports.get(alias)
+
+    # -- reference / reflection resolution ------------------------------
+    def resolve_ref(
+        self, mod: ModuleInfo, class_name: Optional[str], ref: str
+    ) -> List[FunctionInfo]:
+        """A raw callable *reference* (not a call) -> candidate
+        functions: ``"self.handle_x"`` through the class hierarchy,
+        ``"run"`` to a nested/sibling def or module function or import,
+        ``"mod.fn"`` through the symbol table."""
+        if not ref:
+            return []
+        root, _, rest = ref.partition(".")
+        if root in ("self", "cls") and rest and "." not in rest:
+            ci = self.class_info(mod, class_name)
+            if ci is not None:
+                hits = self.method_candidates(ci, rest)
+                if hits:
+                    return hits
+            if class_name is not None:
+                hit = mod.functions.get(f"{class_name}.{rest}")
+                return [hit] if hit is not None else []
+            return []
+        if not rest:  # bare name
+            quals = [ref] if class_name is None else [
+                f"{class_name}.{ref}", ref,
+            ]
+            for qual in quals:
+                hit = mod.functions.get(qual)
+                if hit is not None:
+                    return [hit]
+            target = mod.imports.get(ref)
+            if target is not None:
+                hit = self.function_by_dotted(target)
+                return [hit] if hit is not None else []
+            return []
+        hit = mod.functions.get(ref)  # literal "Class.method"
+        if hit is not None:
+            return [hit]
+        target = mod.imports.get(root)
+        if target is not None:
+            hit = self.function_by_dotted(f"{target}.{rest}")
+            return [hit] if hit is not None else []
+        hit = self.function_by_dotted(ref)
+        return [hit] if hit is not None else []
+
+    def methods_with_prefix(
+        self, mod: ModuleInfo, class_name: Optional[str], prefix: str
+    ) -> List[FunctionInfo]:
+        """Every method in ``class_name``'s hierarchy whose name starts
+        with ``prefix`` — the ``getattr(self, "handle_" + x)`` dispatch
+        set.  An empty prefix resolves to nothing (that is not a
+        statically-known suffix set, it is full dynamism)."""
+        if not prefix or class_name is None:
+            return []
+        out: List[FunctionInfo] = []
+        seen: set = set()
+
+        def scan(cls_name: str, cls_mod: ModuleInfo) -> None:
+            want = f"{cls_name}."
+            for qual, fi in cls_mod.functions.items():
+                if not qual.startswith(want):
+                    continue
+                method = qual[len(want):]
+                if "." in method or not method.startswith(prefix):
+                    continue
+                if fi.key not in seen:
+                    seen.add(fi.key)
+                    out.append(fi)
+
+        ci = self.class_info(mod, class_name)
+        if ci is None:
+            scan(class_name, mod)
+            return out
+        for c in [ci, *self.ancestors(ci), *self.descendants(ci)]:
+            scan(c.name, c.module)
+        return out
+
+    def reflection_targets(
+        self,
+        mod: ModuleInfo,
+        class_name: Optional[str],
+        call: ast.Call,
+        local_tables: Optional[Dict[str, Tuple[str, ...]]] = None,
+    ) -> List[Tuple[FunctionInfo, bool]]:
+        """``(callee, via_self)`` candidates for the reflection call
+        shapes: ``getattr(self, "pre_" + x)(...)`` over the literal
+        prefix, and dispatch-table calls ``tbl[k](...)`` /
+        ``tbl.get(k)(...)`` through function-local, ``self.X``, or
+        module-level dict-literal tables."""
+        func = call.func
+        # getattr(self, <name-expr>)(...)
+        if (
+            isinstance(func, ast.Call)
+            and isinstance(func.func, ast.Name)
+            and func.func.id == "getattr"
+            and len(func.args) >= 2
+            and isinstance(func.args[0], ast.Name)
+            and func.args[0].id in ("self", "cls")
+        ):
+            exact, prefix = _str_pattern(func.args[1])
+            if exact is not None:
+                return [
+                    (fi, True)
+                    for fi in self.resolve_ref(
+                        mod, class_name, f"self.{exact}"
+                    )
+                ]
+            if prefix is not None:
+                return [
+                    (fi, True)
+                    for fi in self.methods_with_prefix(
+                        mod, class_name, prefix
+                    )
+                ]
+            return []
+        # tbl[k](...) / tbl.get(k[, default])(...)
+        base: Optional[ast.AST] = None
+        if isinstance(func, ast.Subscript):
+            base = func.value
+        elif (
+            isinstance(func, ast.Call)
+            and isinstance(func.func, ast.Attribute)
+            and func.func.attr == "get"
+        ):
+            base = func.func.value
+        if base is None:
+            return []
+        refs: Optional[Tuple[str, ...]] = None
+        owner_mod, owner_class = mod, class_name
+        if isinstance(base, ast.Name):
+            if local_tables and base.id in local_tables:
+                refs = local_tables[base.id]
+            else:
+                refs = mod.dispatch_tables.get(base.id)
+        elif isinstance(base, ast.Attribute) and isinstance(
+            base.value, ast.Name
+        ):
+            if base.value.id in ("self", "cls") and class_name is not None:
+                ci = self.class_info(mod, class_name)
+                classes = (
+                    [ci, *self.ancestors(ci), *self.descendants(ci)]
+                    if ci is not None else []
+                )
+                for c in classes:
+                    refs = c.module.class_dispatch_tables.get(
+                        (c.name, base.attr)
+                    )
+                    if refs is not None:
+                        owner_mod, owner_class = c.module, c.name
+                        break
+                if refs is None and ci is None:
+                    refs = mod.class_dispatch_tables.get(
+                        (class_name, base.attr)
+                    )
+            else:
+                target = mod.imports.get(base.value.id)
+                tmod = self.by_name.get(target) if target else None
+                if tmod is not None:
+                    refs = tmod.dispatch_tables.get(base.attr)
+                    owner_mod, owner_class = tmod, None
+        if not refs:
+            return []
+        out: List[Tuple[FunctionInfo, bool]] = []
+        seen: set = set()
+        for ref in refs:
+            via_self = ref.startswith(("self.", "cls."))
+            for fi in self.resolve_ref(owner_mod, owner_class, ref):
+                if fi.key not in seen:
+                    seen.add(fi.key)
+                    out.append((fi, via_self))
+        return out

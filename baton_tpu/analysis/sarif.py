@@ -13,7 +13,11 @@ from __future__ import annotations
 import json
 import pathlib
 
-from baton_tpu.analysis.engine import Report, all_rules
+from baton_tpu.analysis.engine import (
+    Report,
+    all_rules,
+    finding_fingerprints,
+)
 
 SARIF_VERSION = "2.1.0"
 SARIF_SCHEMA = (
@@ -29,11 +33,13 @@ def _artifact_uri(path: str) -> str:
 def sarif_dict(report: Report) -> dict:
     rules = all_rules()
     results = []
-    for f in report.findings:
+    fps = finding_fingerprints(report.findings)
+    for f, fp in zip(report.findings, fps):
         results.append({
             "ruleId": f.rule,
             "level": "warning",
             "message": {"text": f.message},
+            "partialFingerprints": {"batonlintFingerprint/v1": fp},
             "locations": [{
                 "physicalLocation": {
                     "artifactLocation": {

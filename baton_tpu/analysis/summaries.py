@@ -22,6 +22,15 @@ mutates that object's state, not the caller's.
 Every site a summary carries keeps the shortest witness call chain
 (qualnames below the summarized function), so checkers can report the
 path a hazard travels across modules, not just its endpoint.
+
+On top of the per-function facts this module roots the call graph at
+real runtime *entry points* (route registrations, ``PeriodicTask`` and
+loop callbacks, ``to_thread``/executor/``threading.Thread`` dispatch)
+and propagates an execution-context lattice — ``loop``, ``thread``, or
+both — along execution edges (:class:`CtxWitness`).  Context-sensitive
+rules (BTL001/BTL005/BTL006/BTL007) ask :meth:`Summaries.context_kinds`
+which worlds a function can run in, with a witness chain back to the
+registration site for the diagnostic.
 """
 
 from __future__ import annotations
@@ -39,6 +48,7 @@ __all__ = [
     "BLOCKED_METHODS",
     "BLOCKED_MODULE_PREFIXES",
     "BLOCKED_NAMES",
+    "CtxWitness",
     "FnSummary",
     "LocalFacts",
     "NETWORK_ATTRS",
@@ -163,6 +173,154 @@ _SELF_MUTATORS = {
     "popitem", "remove", "discard", "clear", "set",
 }
 
+# -- execution-context registration tables ------------------------------
+# aiohttp route table: method attr -> positional index of the handler
+_ROUTE_REGISTRARS = {
+    "add_get": 1, "add_post": 1, "add_put": 1, "add_patch": 1,
+    "add_delete": 1, "add_head": 1, "add_route": 2,
+}
+# loop-callback registrars: the referenced callable runs ON the loop
+_LOOP_CB_REGISTRARS = {
+    "call_soon": 0, "call_soon_threadsafe": 0, "add_done_callback": 0,
+    "call_later": 1, "call_at": 1,
+}
+# thread dispatchers: the referenced callable runs OFF the loop
+_THREAD_REGISTRARS = {
+    "to_thread": 0,
+    "submit": 0,
+    "run_in_executor": 1,
+    # the ingest pipeline's own executor API (server/ingest.py): both
+    # hand the callable to a ThreadPoolExecutor lane
+    "submit_decode": 0,
+    "submit_fold": 1,
+}
+
+# asyncio primitives a `self.X = asyncio.Y()` assignment declares
+_ASYNCIO_FACTORIES = {
+    "Lock", "Event", "Condition", "Semaphore", "BoundedSemaphore",
+    "Queue", "LifoQueue", "PriorityQueue", "Future",
+}
+# non-threadsafe methods of those primitives (their sync APIs — the
+# ones a worker thread CAN call, incorrectly; awaited APIs need a loop)
+_ASYNCIO_TOUCH_METHODS = {
+    "set", "clear", "put_nowait", "get_nowait", "set_result",
+    "set_exception", "release", "notify", "notify_all",
+}
+# loop-affine methods regardless of receiver attr bookkeeping
+_LOOP_AFFINE_METHODS = {"call_soon", "call_later", "call_at", "create_task"}
+
+
+def _callable_ref(expr: ast.AST) -> Optional[str]:
+    """A callable *reference* expression -> raw dotted ref string
+    (``functools.partial(f, ...)`` unwraps to ``f``)."""
+    d = au.dotted_name(expr)
+    if d is not None:
+        return d
+    if isinstance(expr, ast.Call):
+        cn = au.call_name(expr)
+        if cn is not None and cn.rsplit(".", 1)[-1] == "partial" and expr.args:
+            return _callable_ref(expr.args[0])
+    return None
+
+
+def _alias_envs(tree: ast.Module) -> Dict[int, Dict[str, str]]:
+    """Per function node (by ``id``): local names that are stable
+    aliases of a bare ``self.X`` read (``r = self._round``), with the
+    enclosing function's aliases inherited by nested defs — the channel
+    through which a fold-lane closure mutates instance state.  A name
+    also bound to anything else anywhere in the function is ambiguous
+    and dropped."""
+    envs: Dict[int, Dict[str, str]] = {}
+
+    def own_bindings(fn) -> Tuple[Dict[str, str], set]:
+        aliases: Dict[str, str] = {}
+        shadowed: set = set(au.param_names(fn))
+        for n in au.walk_shallow(fn):
+            if isinstance(n, ast.Assign):
+                src = None
+                v = n.value
+                if (
+                    isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id in ("self", "cls")
+                ):
+                    src = v.attr
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        if src is not None and t.id not in shadowed:
+                            if aliases.get(t.id, src) != src:
+                                shadowed.add(t.id)
+                            else:
+                                aliases[t.id] = src
+                        else:
+                            shadowed.add(t.id)
+                    else:
+                        # tuple/list unpacking rebinds its Store names;
+                        # a store THROUGH the name (r.x[k] = v) does not
+                        # rebind r itself
+                        for e in ast.walk(t):
+                            if isinstance(e, ast.Name) and isinstance(
+                                e.ctx, ast.Store
+                            ):
+                                shadowed.add(e.id)
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                if isinstance(n.target, ast.Name):
+                    shadowed.add(n.target.id)
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                for e in ast.walk(n.target):
+                    if isinstance(e, ast.Name):
+                        shadowed.add(e.id)
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if item.optional_vars is not None:
+                        for e in ast.walk(item.optional_vars):
+                            if isinstance(e, ast.Name):
+                                shadowed.add(e.id)
+            elif isinstance(n, ast.NamedExpr):
+                if isinstance(n.target, ast.Name):
+                    shadowed.add(n.target.id)
+        return (
+            {k: v for k, v in aliases.items() if k not in shadowed},
+            shadowed,
+        )
+
+    def walk(node: ast.AST, inherited: Dict[str, str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                own, shadowed = own_bindings(child)
+                env = {
+                    k: v for k, v in inherited.items()
+                    if k not in shadowed and k not in own
+                }
+                env.update(own)
+                envs[id(child)] = env
+                walk(child, env)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, {})
+            else:
+                walk(child, inherited)
+
+    walk(tree, {})
+    return envs
+
+
+def _scope_names(fn) -> Tuple[set, set, set]:
+    """``(store_locals, all_locals, global_decls)`` for one function:
+    names bound by Name-store/params, the same plus nested-def names,
+    and names declared ``global``."""
+    gdecl: set = set()
+    store_locals: set = set(au.param_names(fn))
+    def_names: set = set()
+    for n in au.walk_shallow(fn):
+        if isinstance(n, ast.Global):
+            gdecl.update(n.names)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            def_names.add(n.name)
+        elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            store_locals.add(n.id)
+    store_locals -= gdecl
+    return store_locals, store_locals | def_names, gdecl
+
 
 def _self_attr_of(node: ast.AST) -> Optional[str]:
     """``self.A``/``cls.A`` (possibly deeper: ``self.A.b``) -> ``A``."""
@@ -206,6 +364,29 @@ class LocalFacts:
     # hazards when this function executes under a jit/shard_map trace
     taint_ops: Tuple[Tuple[bool, str, int, int, str], ...] = ()
     returns_param_taint: bool = False
+    # -- execution-context facts (also per-file, also cacheable) -------
+    # ((attr, line, col, is_write, (sync_locks...), (async_locks...)),
+    #  ...) instance-attribute accesses incl. through self-aliases
+    attr_accesses: Tuple[
+        Tuple[str, int, int, bool, Tuple[str, ...], Tuple[str, ...]], ...
+    ] = ()
+    # ((name, line, col, is_write, (sync_locks...)), ...) module-global
+    # accesses (reads of module names, `global`-declared / container
+    # mutation writes)
+    global_accesses: Tuple[
+        Tuple[str, int, int, bool, Tuple[str, ...]], ...
+    ] = ()
+    # self.X attrs assigned an asyncio primitive in this function
+    asyncio_defs: Tuple[str, ...] = ()
+    # ((attr_or_recv, line, col, method), ...) non-threadsafe asyncio
+    # API touches ("<loop>" recv for call_soon/create_task et al.)
+    asyncio_touches: Tuple[Tuple[str, int, int, str], ...] = ()
+    # ((kind, ref, line), ...) entry-point registrations made HERE:
+    # kind in {"route", "loop_cb", "thread"}, ref is the raw callable
+    entry_regs: Tuple[Tuple[str, str, int], ...] = ()
+    # bare/dotted names referenced outside call position (callbacks
+    # passed by value) — dead-code roots
+    name_refs: Tuple[str, ...] = ()
 
     def to_json(self) -> dict:
         return {
@@ -225,6 +406,18 @@ class LocalFacts:
             "self_writes": list(self.self_writes),
             "taint_ops": [list(x) for x in self.taint_ops],
             "returns_param_taint": self.returns_param_taint,
+            "attr_accesses": [
+                [a, ln, c, w, list(s), list(al)]
+                for a, ln, c, w, s, al in self.attr_accesses
+            ],
+            "global_accesses": [
+                [a, ln, c, w, list(s)]
+                for a, ln, c, w, s in self.global_accesses
+            ],
+            "asyncio_defs": list(self.asyncio_defs),
+            "asyncio_touches": [list(x) for x in self.asyncio_touches],
+            "entry_regs": [list(x) for x in self.entry_regs],
+            "name_refs": list(self.name_refs),
         }
 
     @classmethod
@@ -259,6 +452,28 @@ class LocalFacts:
                 for a, b, c, d, e in data.get("taint_ops", [])
             ),
             returns_param_taint=bool(data.get("returns_param_taint", False)),
+            attr_accesses=tuple(
+                (str(a), int(ln), int(c), bool(w),
+                 tuple(str(x) for x in s), tuple(str(x) for x in al))
+                for a, ln, c, w, s, al in data.get("attr_accesses", [])
+            ),
+            global_accesses=tuple(
+                (str(a), int(ln), int(c), bool(w),
+                 tuple(str(x) for x in s))
+                for a, ln, c, w, s in data.get("global_accesses", [])
+            ),
+            asyncio_defs=tuple(
+                str(x) for x in data.get("asyncio_defs", [])
+            ),
+            asyncio_touches=tuple(
+                (str(a), int(b), int(c), str(d))
+                for a, b, c, d in data.get("asyncio_touches", [])
+            ),
+            entry_regs=tuple(
+                (str(a), str(b), int(c))
+                for a, b, c in data.get("entry_regs", [])
+            ),
+            name_refs=tuple(str(x) for x in data.get("name_refs", [])),
         )
 
 
@@ -267,13 +482,18 @@ _SUSPENDERS = (ast.Await, ast.AsyncFor, ast.AsyncWith)
 
 def compute_local_facts(mod: ModuleInfo) -> Dict[str, LocalFacts]:
     """``{qualname: LocalFacts}`` for every function in the module."""
+    envs = _alias_envs(mod.tree)
     out: Dict[str, LocalFacts] = {}
     for fn_info in mod.functions.values():
-        out[fn_info.qualname] = _local_facts_for(fn_info)
+        out[fn_info.qualname] = _local_facts_for(
+            fn_info, envs.get(id(fn_info.node), {}), mod
+        )
     return out
 
 
-def _local_facts_for(fn_info) -> LocalFacts:
+def _local_facts_for(
+    fn_info, alias_env: Dict[str, str], mod: ModuleInfo
+) -> LocalFacts:
     node = fn_info.node
     is_async = isinstance(node, ast.AsyncFunctionDef)
     blocking: List[Tuple[int, int, str, str]] = []
@@ -285,45 +505,232 @@ def _local_facts_for(fn_info) -> LocalFacts:
     self_writes: set = set()
     has_await = False
 
+    attr_accesses: List[
+        Tuple[str, int, int, bool, Tuple[str, ...], Tuple[str, ...]]
+    ] = []
+    attr_seen: set = set()
+    global_accesses: List[Tuple[str, int, int, bool, Tuple[str, ...]]] = []
+    global_seen: set = set()
+    asyncio_defs: set = set()
+    asyncio_touches: List[Tuple[str, int, int, str]] = []
+    entry_regs: List[Tuple[str, str, int]] = []
+    name_refs: set = set()
+
+    store_locals, all_locals, global_decls = _scope_names(node)
+    mod_globals = mod.global_names
+
     def is_lock_name(name: Optional[str]) -> bool:
         if name is None:
             return False
         leaf = name.rsplit(".", 1)[-1].lower()
         return leaf.endswith("lock") or leaf.endswith("mutex")
 
-    def visit(n: ast.AST, held: Tuple[str, ...]) -> None:
+    def norm_dotted(expr: ast.AST) -> Optional[str]:
+        """Dotted name with self-aliases rewritten back through self."""
+        d = au.dotted_name(expr)
+        if d is None:
+            return None
+        root, _, rest = d.partition(".")
+        if root in alias_env:
+            base = f"self.{alias_env[root]}"
+            return f"{base}.{rest}" if rest else base
+        return d
+
+    def access_attr_of(n: ast.AST) -> Optional[str]:
+        """Full dotted instance path of an access chain, through
+        aliases: ``self.A.b`` -> ``A.b``; ``r.acc`` with
+        ``r = self._round`` -> ``_round.acc``; subscripts are
+        transparent (``r.tbl[k]`` writes into the object at
+        ``_round.tbl``).  Leaf-path granularity lets a fold-lane write
+        to ``_round.acc`` coexist with loop-side bookkeeping on
+        ``_round.contributors`` — disjoint leaves never race."""
+        parts: List[str] = []
+        while isinstance(n, (ast.Attribute, ast.Subscript)):
+            if isinstance(n, ast.Attribute):
+                parts.append(n.attr)
+            n = n.value
+        if not isinstance(n, ast.Name):
+            return None
+        if n.id in ("self", "cls"):
+            pass
+        elif n.id in alias_env:
+            parts.append(alias_env[n.id])
+        else:
+            return None
+        if not parts:
+            return None
+        return ".".join(reversed(parts))
+
+    def global_root_of(n: ast.AST) -> Optional[str]:
+        """Module-global root name of an access chain, or None."""
+        while isinstance(n, (ast.Attribute, ast.Subscript)):
+            n = n.value
+        if (
+            isinstance(n, ast.Name)
+            and n.id in mod_globals
+            and n.id not in all_locals
+        ):
+            return n.id
+        return None
+
+    def record_attr(
+        attr: str, n: ast.AST, is_write: bool,
+        sheld: Tuple[str, ...], aheld: Tuple[str, ...],
+    ) -> None:
+        key = (attr, is_write, sheld, aheld)
+        if key in attr_seen:
+            return
+        attr_seen.add(key)
+        attr_accesses.append(
+            (attr, n.lineno, n.col_offset, is_write, sheld, aheld)
+        )
+
+    def record_global(
+        name: str, n: ast.AST, is_write: bool, sheld: Tuple[str, ...]
+    ) -> None:
+        key = (name, is_write, sheld)
+        if key in global_seen:
+            return
+        global_seen.add(key)
+        global_accesses.append(
+            (name, n.lineno, n.col_offset, is_write, sheld)
+        )
+
+    def record_entry(kind: str, expr: ast.AST, line: int) -> None:
+        ref = _callable_ref(expr)
+        if ref is not None:
+            entry_regs.append((kind, ref, line))
+
+    def scan_call(
+        n: ast.Call, sheld: Tuple[str, ...], aheld: Tuple[str, ...]
+    ) -> None:
+        """Entry-point registrations + asyncio touches at one call."""
+        func = n.func
+        leaf = None
+        if isinstance(func, ast.Attribute):
+            leaf = func.attr
+        elif isinstance(func, ast.Name):
+            leaf = func.id
+        cn = au.call_name(n)
+        cleaf = cn.rsplit(".", 1)[-1] if cn else leaf
+        if leaf in _ROUTE_REGISTRARS:
+            idx = _ROUTE_REGISTRARS[leaf]
+            if len(n.args) > idx:
+                record_entry("route", n.args[idx], n.lineno)
+        if leaf in _LOOP_CB_REGISTRARS:
+            idx = _LOOP_CB_REGISTRARS[leaf]
+            if len(n.args) > idx:
+                record_entry("loop_cb", n.args[idx], n.lineno)
+        if cleaf == "PeriodicTask" and n.args:
+            record_entry("loop_cb", n.args[0], n.lineno)
+        if leaf in _THREAD_REGISTRARS:
+            idx = _THREAD_REGISTRARS[leaf]
+            if len(n.args) > idx:
+                record_entry("thread", n.args[idx], n.lineno)
+        if cleaf == "Thread":
+            for kw in n.keywords:
+                if kw.arg == "target":
+                    record_entry("thread", kw.value, n.lineno)
+        if isinstance(func, ast.Attribute):
+            if func.attr in _ASYNCIO_TOUCH_METHODS:
+                recv = func.value
+                attr = None
+                if (
+                    isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id in ("self", "cls")
+                ):
+                    attr = recv.attr
+                elif isinstance(recv, ast.Name) and recv.id in alias_env:
+                    attr = alias_env[recv.id]
+                if attr is not None:
+                    asyncio_touches.append(
+                        (attr, n.lineno, n.col_offset, func.attr)
+                    )
+            if func.attr in _LOOP_AFFINE_METHODS:
+                asyncio_touches.append(
+                    ("<loop>", n.lineno, n.col_offset, func.attr)
+                )
+
+    def scan_asyncio_def(n: ast.Assign) -> None:
+        if not isinstance(n.value, ast.Call):
+            return
+        cn = au.call_name(n.value)
+        if cn is None:
+            return
+        root, _, fleaf = cn.rpartition(".")
+        is_factory = fleaf == "create_future" or (
+            fleaf in _ASYNCIO_FACTORIES
+            and (
+                root == "asyncio"
+                or (not root and mod.imports.get(fleaf, "").startswith(
+                    "asyncio."
+                ))
+            )
+        )
+        if not is_factory:
+            return
+        for t in n.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id in ("self", "cls")
+            ):
+                asyncio_defs.add(t.attr)
+
+    def visit(
+        n: ast.AST, aheld: Tuple[str, ...], sheld: Tuple[str, ...]
+    ) -> None:
         nonlocal has_await
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                           ast.Lambda)):
             return  # separate execution context (to_thread closures)
         if isinstance(n, _SUSPENDERS):
             has_await = True
-            awaits_held_raw.update(held)
-        if isinstance(n, ast.AsyncWith):
-            new_held = held
+            awaits_held_raw.update(aheld)
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            is_sync_with = isinstance(n, ast.With)
+            new_aheld, new_sheld = aheld, sheld
             header = [i.context_expr for i in n.items]
             for item in n.items:
                 expr = item.context_expr
-                raw = au.dotted_name(expr)
+                raw = norm_dotted(expr)
                 if is_lock_name(raw):
-                    acquires_raw.append((raw, n.lineno))
-                    new_held = new_held + (raw,)
+                    if is_sync_with:
+                        new_sheld = new_sheld + (raw,)
+                        visit(expr, aheld, sheld)
+                    else:
+                        acquires_raw.append((raw, n.lineno))
+                        new_aheld = new_aheld + (raw,)
+                        attr = access_attr_of(expr)
+                        if attr is not None:
+                            record_attr(attr, expr, False, sheld, aheld)
                 elif isinstance(expr, ast.Call):
-                    if is_network_call(expr):
+                    if is_sync_with:
+                        reason = blocked_reason(expr)
+                        if reason is not None:
+                            blocking.append(
+                                (expr.lineno, expr.col_offset,
+                                 reason[0], reason[1])
+                            )
+                    elif is_network_call(expr):
                         network_awaits.append(
                             (expr.lineno, expr.col_offset,
                              au.call_name(expr)
                              or f"<expr>.{expr.func.attr}")
                         )
                     held_at_call.append(
-                        (expr.lineno, expr.col_offset, held)
+                        (expr.lineno, expr.col_offset, aheld)
                     )
+                    scan_call(expr, sheld, aheld)
                     for child in ast.iter_child_nodes(expr):
-                        visit(child, held)
+                        visit(child, aheld, sheld)
+                else:
+                    visit(expr, aheld, sheld)
             for child in ast.iter_child_nodes(n):
                 if child in header or isinstance(child, ast.withitem):
                     continue
-                visit(child, new_held)
+                visit(child, new_aheld, new_sheld)
             return
         if isinstance(n, ast.Await) and isinstance(n.value, ast.Call):
             if is_network_call(n.value):
@@ -338,7 +745,8 @@ def _local_facts_for(fn_info) -> LocalFacts:
                 blocking.append(
                     (n.lineno, n.col_offset, reason[0], reason[1])
                 )
-            held_at_call.append((n.lineno, n.col_offset, held))
+            held_at_call.append((n.lineno, n.col_offset, aheld))
+            scan_call(n, sheld, aheld)
         if isinstance(n, ast.Attribute):
             attr = (
                 n.attr
@@ -346,20 +754,66 @@ def _local_facts_for(fn_info) -> LocalFacts:
                 and n.value.id in ("self", "cls")
                 else None
             )
+            is_store = isinstance(n.ctx, (ast.Store, ast.Del))
             if attr is not None:
-                if isinstance(n.ctx, (ast.Store, ast.Del)):
+                if is_store:
                     self_writes.add(attr)
                 else:
                     self_reads.add(attr)
+                record_attr(attr, n, is_store, sheld, aheld)
+            elif (
+                isinstance(n.value, ast.Name)
+                and n.value.id in alias_env
+            ):
+                path = access_attr_of(n)
+                if path is not None:
+                    record_attr(path, n, is_store, sheld, aheld)
+            if (
+                isinstance(n.ctx, ast.Load)
+                and isinstance(n.value, ast.Name)
+                and id(n) not in callfunc_ids
+            ):
+                base = n.value.id
+                if base in ("self", "cls"):
+                    name_refs.add(f"self.{n.attr}")
+                elif base not in store_locals:
+                    name_refs.add(f"{base}.{n.attr}")
+        if isinstance(n, ast.Name):
+            if (
+                isinstance(n.ctx, ast.Load)
+                and n.id in mod_globals
+                and n.id not in all_locals
+            ):
+                record_global(n.id, n, False, sheld)
+            elif isinstance(n.ctx, ast.Store) and n.id in global_decls:
+                record_global(n.id, n, True, sheld)
+            if (
+                isinstance(n.ctx, ast.Load)
+                and id(n) not in callfunc_ids
+                and n.id not in store_locals
+            ):
+                name_refs.add(n.id)
         if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-            targets = (
+            if isinstance(n, ast.Assign):
+                scan_asyncio_def(n)
+            targets = list(
                 n.targets if isinstance(n, ast.Assign) else [n.target]
             )
+            # unpack `a, self.x = ...` so the attribute store is seen
+            for t in list(targets):
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    targets.extend(t.elts)
             for t in targets:
                 if isinstance(t, (ast.Subscript, ast.Attribute)):
                     attr = _self_attr_of(t)
                     if attr is not None:
                         self_writes.add(attr)
+                    aattr = access_attr_of(t)
+                    if aattr is not None:
+                        record_attr(aattr, t, True, sheld, aheld)
+                    g = global_root_of(t)
+                    if g is not None:
+                        record_global(g, t, True, sheld)
         if (
             isinstance(n, ast.Call)
             and isinstance(n.func, ast.Attribute)
@@ -368,11 +822,25 @@ def _local_facts_for(fn_info) -> LocalFacts:
             attr = _self_attr_of(n.func.value)
             if attr is not None:
                 self_writes.add(attr)
+            aattr = access_attr_of(n.func.value)
+            if aattr is not None:
+                record_attr(aattr, n, True, sheld, aheld)
+            g = global_root_of(n.func.value)
+            if g is not None:
+                record_global(g, n, True, sheld)
         for child in ast.iter_child_nodes(n):
-            visit(child, held)
+            visit(child, aheld, sheld)
+
+    # call-position func Name/Attribute nodes: not "references by value"
+    callfunc_ids: set = set()
+    for sub in au.walk_shallow(node):
+        if isinstance(sub, ast.Call) and isinstance(
+            sub.func, (ast.Name, ast.Attribute)
+        ):
+            callfunc_ids.add(id(sub.func))
 
     for stmt in node.body:
-        visit(stmt, ())
+        visit(stmt, (), ())
 
     taint_ops, returns_taint = _local_taint_facts(node)
     return LocalFacts(
@@ -389,6 +857,12 @@ def _local_facts_for(fn_info) -> LocalFacts:
         self_writes=tuple(sorted(self_writes)),
         taint_ops=taint_ops,
         returns_param_taint=returns_taint,
+        attr_accesses=tuple(attr_accesses),
+        global_accesses=tuple(global_accesses),
+        asyncio_defs=tuple(sorted(asyncio_defs)),
+        asyncio_touches=tuple(asyncio_touches),
+        entry_regs=tuple(entry_regs),
+        name_refs=tuple(sorted(name_refs)),
     )
 
 
@@ -460,6 +934,26 @@ def _local_taint_facts(node) -> Tuple[tuple, bool]:
                      "transfer per trace")
                 )
     return tuple(ops), returns_taint
+
+
+# -- execution contexts ------------------------------------------------
+@dataclasses.dataclass
+class CtxWitness:
+    """Why a function runs in a given execution context: the entry
+    point that roots it plus the shortest call chain found from there.
+    ``seed`` is the entry-point flavor ("async" | "route" | "loop_cb" |
+    "thread"); ``server`` is whether the REGISTERING module is part of
+    the server/obs runtime (scopes BTL005/BTL006 reporting)."""
+
+    kind: str                  # "loop" | "thread"
+    root_key: str              # function key of the entry point
+    root_qual: str
+    reason: str                # human wording for the entry point
+    seed: str
+    chain: Tuple[str, ...]     # qualnames from root (exclusive) to fn
+    reg_path: str              # module registering the entry point
+    reg_line: int
+    server: bool
 
 
 # -- fixpoint summaries ------------------------------------------------
@@ -580,12 +1074,22 @@ class Summaries:
                     self.locals[fi.key] = lf
         self.by_key: Dict[str, FnSummary] = {}
         self._compute()
+        # key -> {"loop": CtxWitness, "thread": CtxWitness}
+        self.contexts: Dict[str, Dict[str, CtxWitness]] = {}
+        self._compute_contexts()
 
     def get(self, key: str) -> Optional[FnSummary]:
         return self.by_key.get(key)
 
     def for_function(self, fn_info) -> Optional[FnSummary]:
         return self.by_key.get(fn_info.key)
+
+    def context_kinds(self, key: str) -> FrozenSet[str]:
+        """``{"loop"}``, ``{"thread"}``, both, or empty (unrooted)."""
+        return frozenset(self.contexts.get(key, ()))
+
+    def witness(self, key: str, kind: str) -> Optional[CtxWitness]:
+        return self.contexts.get(key, {}).get(kind)
 
     # ------------------------------------------------------------------
     def _compute(self) -> None:
@@ -725,6 +1229,97 @@ class Summaries:
                     )
                     changed = True
         return changed
+
+    # ------------------------------------------------------------------
+    def _compute_contexts(self) -> None:
+        """Root the call graph at real runtime entry points and
+        propagate a {loop, thread} context lattice along execution
+        edges.  Seeds: every ``async def`` runs on the loop; a callable
+        registered as a route handler / loop callback / ``PeriodicTask``
+        runs on the loop; one handed to ``to_thread`` / an executor /
+        ``threading.Thread`` runs on a worker thread.  Propagation into
+        an ``async def`` is skipped (sync frames merely build the
+        coroutine; async frames carry their own loop seed), so a
+        thread-context caller never taints a coroutine it schedules."""
+        project = self.project
+        from collections import deque
+
+        def fn_is_async(fn) -> bool:
+            lf = self.locals.get(fn.key)
+            if lf is not None:
+                return lf.is_async
+            return isinstance(fn.node, ast.AsyncFunctionDef)
+
+        seeds: List[Tuple[str, CtxWitness]] = []
+        for fn in project.functions():
+            lf = self.locals.get(fn.key)
+            if lf is None:
+                continue
+            server = any(p in ("server", "obs") for p in fn.module.parts)
+            if lf.is_async:
+                seeds.append((fn.key, CtxWitness(
+                    "loop", fn.key, fn.qualname, "async def", "async",
+                    (), fn.module.path, fn.node.lineno, server,
+                )))
+            for kind, ref, line in lf.entry_regs:
+                for target in project.resolve_ref(
+                    fn.module, fn.class_name, ref
+                ):
+                    if fn_is_async(target):
+                        # a coroutine function keeps its loop seed no
+                        # matter who schedules or threads it
+                        continue
+                    if kind == "thread":
+                        w = CtxWitness(
+                            "thread", target.key, target.qualname,
+                            f"dispatched to a worker thread by "
+                            f"{fn.qualname}()", "thread", (),
+                            fn.module.path, line, server,
+                        )
+                    else:
+                        seed = "route" if kind == "route" else "loop_cb"
+                        what = (
+                            "registered as a route handler"
+                            if kind == "route"
+                            else "scheduled as a loop callback"
+                        )
+                        w = CtxWitness(
+                            "loop", target.key, target.qualname,
+                            f"{what} by {fn.qualname}()", seed, (),
+                            fn.module.path, line, server,
+                        )
+                    seeds.append((target.key, w))
+
+        contexts = self.contexts
+        queue: "deque[Tuple[str, str]]" = deque()
+
+        def install(key: str, w: CtxWitness) -> None:
+            cur = contexts.setdefault(key, {})
+            prev = cur.get(w.kind)
+            if prev is None or (w.server and not prev.server):
+                cur[w.kind] = w
+                queue.append((key, w.kind))
+
+        for key, w in seeds:
+            install(key, w)
+
+        while queue:
+            key, kind = queue.popleft()
+            w = contexts[key][kind]
+            caller = self.by_key.get(key)
+            for edge in self.graph.callees(key):
+                callee = self.by_key.get(edge.callee.key)
+                if callee is None or callee.is_async:
+                    # sync->async builds a coroutine object; async
+                    # callees are loop-seeded directly
+                    continue
+                if caller is None:
+                    continue
+                install(edge.callee.key, CtxWitness(
+                    kind, w.root_key, w.root_qual, w.reason, w.seed,
+                    w.chain + (edge.callee.qualname,),
+                    w.reg_path, w.reg_line, w.server,
+                ))
 
 
 def get_summaries(project: Project) -> Summaries:

@@ -82,6 +82,7 @@ import math
 import os
 import re
 import tempfile
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, Optional
@@ -531,6 +532,11 @@ class Experiment:
         # streaming FedAvg accumulator for the round in flight (None for
         # robust/secure rounds, which need the buffered path)
         self._stream_acc = None
+        # owns every _stream_acc mutation: fold-lane threads add() into
+        # it while the loop swaps/rebuilds it (and the simulated-cohort
+        # path add()s on the loop) — an asyncio.Lock cannot exclude the
+        # lanes, so this must be a threading.Lock on BOTH sides
+        self._acc_lock = threading.Lock()
         self.streaming_aggregation = bool(streaming_aggregation)
         if max_upload_bytes is not None and max_upload_bytes < 1:
             raise ValueError(
@@ -908,11 +914,13 @@ class Experiment:
             for k, v in params_to_state_dict(self.params).items()
         }
         self._broadcast_anchor_sd = state_dict
-        self._stream_acc = (
-            self._new_stream_acc()
-            if self.streaming_aggregation and self.aggregator[0] == "mean"
-            else None
-        )
+        with self._acc_lock:
+            self._stream_acc = (
+                self._new_stream_acc()
+                if self.streaming_aggregation
+                and self.aggregator[0] == "mean"
+                else None
+            )
         if self.allow_pickle:
             meta_out = {"update_name": round_name, "n_epoch": n_epoch}
             body = wire.encode_pickle(state_dict, meta_out)
@@ -2221,10 +2229,14 @@ class Experiment:
                 if compressed:
                     t = self._decompress_upload(t, anchor)
                 payload = {k: t[k] for k in anchor}
-                if sharded:
-                    acc.add(payload, meta_n_samples, shard=shard)
-                else:
-                    acc.add(payload, meta_n_samples)
+                # decompress ran lock-free above (pure); only the fold
+                # into the shared accumulator needs _acc_lock — the
+                # loop-side simulated cohort add()s into the same one
+                with self._acc_lock:
+                    if sharded:
+                        acc.add(payload, meta_n_samples, shard=shard)
+                    else:
+                        acc.add(payload, meta_n_samples)
 
             if pipe is not None:
                 await pipe.submit_fold(shard, fold)
@@ -2691,13 +2703,14 @@ class Experiment:
         # Robust aggregators are order statistics over the whole cohort
         # and secure rounds only ever yield a masked SUM — both keep the
         # buffered path (self._stream_acc stays None).
-        self._stream_acc = (
-            self._new_stream_acc()
-            if self.streaming_aggregation
-            and self.aggregator[0] == "mean"
-            and not self.secure_agg
-            else None
-        )
+        with self._acc_lock:
+            self._stream_acc = (
+                self._new_stream_acc()
+                if self.streaming_aggregation
+                and self.aggregator[0] == "mean"
+                and not self.secure_agg
+                else None
+            )
         state_dict = params_to_state_dict(self.params)
         meta = {"update_name": round_name, "n_epoch": n_epoch}
         # pin_shapes actuation: ask the cohort to hold batch/sequence
@@ -3357,11 +3370,14 @@ class Experiment:
             response["compute"] = sim_compute
         result_sd = params_to_state_dict(result.params)
         if self._stream_acc is not None:
-            # the simulated cohort streams like any other participant
-            self._stream_acc.add(
-                {k: np.asarray(v) for k, v in result_sd.items()},
-                response["n_samples"],
-            )
+            # the simulated cohort streams like any other participant;
+            # _acc_lock because real uploads fold into the same
+            # accumulator from the ingest lanes
+            with self._acc_lock:
+                self._stream_acc.add(
+                    {k: np.asarray(v) for k, v in result_sd.items()},
+                    response["n_samples"],
+                )
             response["streamed"] = True
         else:
             response["state_dict"] = result_sd
@@ -3442,7 +3458,8 @@ class Experiment:
             "round_s", self.rounds.elapsed,
             exemplar=(trace_id, tracing.root_span_id(trace_id)),
         )
-        acc, self._stream_acc = self._stream_acc, None
+        with self._acc_lock:
+            acc, self._stream_acc = self._stream_acc, None
         if self._ingest is not None:
             # an accepted update's 200 promised its fold would land in
             # the mean; a forced finish (watchdog expiry, explicit

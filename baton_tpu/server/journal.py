@@ -66,6 +66,7 @@ import hmac
 import json
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -171,6 +172,12 @@ class Journal:
         #: bumps on every compaction — the WAL shipper's frame id, since
         #: compaction truncates the file and resets byte offsets
         self.generation = 0
+        #: makes (generation, journal bytes, snapshot file) one atomic
+        #: frame: the WAL shipper builds segments on a worker thread
+        #: holding this, so a compaction (truncate + generation bump) on
+        #: the loop can never tear a segment mid-read.  A threading.Lock
+        #: because the reader is NOT on the event loop.
+        self.io_lock = threading.Lock()
         self._wrap_key = load_wrap_key()
 
     # ------------------------------------------------------------------
@@ -187,10 +194,14 @@ class Journal:
             fields = dict(fields, data=wrap_value(fields["data"],
                                                   self._wrap_key))
         rec = {"event": event, **fields}
-        self._fh.write(json.dumps(rec, separators=(",", ":")) + "\n")
-        self._fh.flush()
-        self._maybe_fsync()
-        self.appends += 1
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        # brief critical section: a segment build holding io_lock on a
+        # worker thread sees either all of this append or none of it
+        with self.io_lock:
+            self._fh.write(line)
+            self._fh.flush()
+            self._maybe_fsync()
+            self.appends += 1
 
     def _maybe_fsync(self) -> None:
         if self.fsync == "never":
@@ -275,15 +286,20 @@ class Journal:
             json.dump(snapshot, fh, separators=(",", ":"))
             fh.flush()
             os.fsync(fh.fileno())
-        os.replace(tmp, self.snapshot_path)
-        # events up to here are superseded by the snapshot: truncate
-        self._fh.close()
-        self._fh = open(self.path, "w", encoding="utf-8")
-        self._fh.flush()
-        if self.fsync != "never":
-            os.fsync(self._fh.fileno())
-        # byte offsets restart from zero — a new shipping generation
-        self.generation += 1
+        # io_lock spans snapshot publication, truncation and the
+        # generation bump: a concurrent segment build must see the
+        # pre-compaction frame or the post-compaction frame, never a
+        # fresh snapshot with a stale generation
+        with self.io_lock:
+            os.replace(tmp, self.snapshot_path)
+            # events up to here are superseded by the snapshot: truncate
+            self._fh.close()
+            self._fh = open(self.path, "w", encoding="utf-8")
+            self._fh.flush()
+            if self.fsync != "never":
+                os.fsync(self._fh.fileno())
+            # byte offsets restart from zero — a new shipping generation
+            self.generation += 1
 
     def close(self) -> None:
         if not self._fh.closed:

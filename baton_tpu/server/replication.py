@@ -302,20 +302,20 @@ class WalShipper:
 
     # -- segments ------------------------------------------------------
     def _read_tail(self, offset: int) -> str:
-        # deliberately blocking: the cursor read and the file read must
-        # happen with no await between them (a compaction slipping in
-        # would tear the segment), and compaction bounds the journal to
-        # ~one round of events, so the read is small
-        with open(self.journal.path, "rb") as fh:  # batonlint: allow[BTL001]
+        # runs on a worker thread (ship_once routes segment builds
+        # through asyncio.to_thread), under journal.io_lock — the lock,
+        # not loop-atomicity, is what keeps a compaction from tearing
+        # the segment between the cursor read and the file read
+        with open(self.journal.path, "rb") as fh:
             fh.seek(offset)
             return fh.read().decode("utf-8")
 
     def _full_segment(self, epoch: int, lease: Optional[dict]) -> dict:
         snap = None
         if os.path.exists(self.journal.snapshot_path):
-            # same atomicity constraint as _read_tail; snapshots are one
-            # compacted state, not history
-            with open(self.journal.snapshot_path, "r",  # batonlint: allow[BTL001]
+            # same frame-consistency contract as _read_tail; snapshots
+            # are one compacted state, not history
+            with open(self.journal.snapshot_path, "r",
                       encoding="utf-8") as fh:
                 snap = fh.read()
         return {
@@ -334,6 +334,23 @@ class WalShipper:
             "snapshot": None, "lease": lease,
         }
 
+    def _build_segment(self, epoch: int, lease: Optional[dict],
+                       generation: Any, offset: int,
+                       need_full: bool) -> dict:
+        """Build one standby's segment on a worker thread.
+
+        ``journal.io_lock`` makes (generation, journal bytes, snapshot)
+        one atomic frame: appends and compactions on the loop wait for
+        the read, instead of the read blocking the loop.  The full-vs-
+        tail decision is re-taken UNDER the lock — a compaction that
+        landed after the loop-side cursor read bumps the generation, and
+        shipping a tail against the truncated file would feed the
+        standby a torn frame."""
+        with self.journal.io_lock:
+            if need_full or generation != self.journal.generation:
+                return self._full_segment(epoch, lease)
+            return self._tail_segment(epoch, offset, lease)
+
     # -- the pump ------------------------------------------------------
     async def ship_once(self, epoch: int,
                         lease: Optional[dict] = None) -> None:
@@ -344,12 +361,14 @@ class WalShipper:
         for url, t in self._targets.items():
             if t["fenced"]:
                 continue
-            # no await between reading the cursor and reading the file:
-            # the segment is consistent with the journal at this instant
-            if t["need_full"] or t["generation"] != self.journal.generation:
-                seg = self._full_segment(epoch, lease)
-            else:
-                seg = self._tail_segment(epoch, t["offset"], lease)
+            # the cursor snapshot crosses an await here, but the build
+            # re-validates it against the live generation under
+            # journal.io_lock — a mid-flight compaction downgrades this
+            # ship to a full segment instead of tearing it
+            seg = await asyncio.to_thread(
+                self._build_segment, epoch, lease,
+                t["generation"], t["offset"], t["need_full"],
+            )
             await self._post(url, t, seg)
 
     async def _post(self, url: str, t: dict, seg: dict) -> None:

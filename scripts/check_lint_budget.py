@@ -7,11 +7,14 @@ Runs batonlint twice over the same tree with a shared summary cache:
   2. warm — same invocation again; every per-file summary must come
      out of ``.batonlint_cache.json`` (hits == files, misses == 0)
 
-and fails the job when either run exceeds its wall-time budget or the
-second run missed the cache. That pins two properties the fixpoint
-rewrite promised: the whole-program analysis stays cheap enough to run
-before the pytest budget, and the content-hash cache actually delivers
-incremental reruns instead of silently recomputing everything.
+and fails the job when either run exceeds its wall-time budget, the
+second run missed the cache, or the SARIF artifact is missing rule
+metadata for the execution-context rules (BTL005/BTL006/BTL007 — the
+driver descriptors code-scanning UIs key on). That pins three
+properties: the whole-program analysis stays cheap enough to run
+before the pytest budget, the content-hash cache actually delivers
+incremental reruns instead of silently recomputing everything, and
+the context rules are registered in the build CI actually ran.
 
 Exit codes: 0 all gates pass, 1 a gate failed, 2 lint itself found
 problems or crashed (the lint step's own failure mode, surfaced as-is).
@@ -105,6 +108,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             "warm run did not come from cache: "
             f"hits={warm_cache.get('hits')} misses={warm_cache.get('misses')} "
             f"files={files}"
+        )
+
+    sarif_path = art / "batonlint.sarif"
+    try:
+        sarif = json.loads(sarif_path.read_text())
+        sarif_rules = {
+            r.get("id")
+            for run in sarif.get("runs", [])
+            for r in run.get("tool", {}).get("driver", {}).get("rules", [])
+        }
+    except (OSError, ValueError) as exc:
+        sarif_rules = set()
+        failures.append(f"SARIF artifact unreadable: {exc}")
+    missing = {"BTL005", "BTL006", "BTL007"} - sarif_rules
+    if missing:
+        failures.append(
+            "SARIF driver metadata missing execution-context rules: "
+            + ", ".join(sorted(missing))
         )
 
     report = {

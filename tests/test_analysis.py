@@ -1342,9 +1342,9 @@ def test_real_metrics_registry_declares_compute_names():
 def test_all_rules_table():
     table = all_rules()
     assert set(table) == {
-        "BTL000", "BTL001", "BTL002", "BTL003", "BTL004", "BTL010",
-        "BTL011", "BTL020", "BTL030", "BTL031", "BTL032", "BTL033",
-        "BTL034",
+        "BTL000", "BTL001", "BTL002", "BTL003", "BTL004", "BTL005",
+        "BTL006", "BTL007", "BTL010", "BTL011", "BTL020", "BTL030",
+        "BTL031", "BTL032", "BTL033", "BTL034",
     }
     assert all(table.values())
 
@@ -2143,6 +2143,572 @@ def test_cli_sarif_writes_valid_json(tmp_path, capsys):
     doc = _json.loads(out.read_text())
     assert doc["version"] == "2.1.0"
     assert doc["runs"][0]["results"][0]["ruleId"] == "BTL020"
+
+
+# ----------------------------------------------------------------------
+# execution contexts: entry-point rooting + context-sensitive BTL001
+
+
+def test_context_sync_route_handler_rooted_on_loop():
+    # a SYNC route handler runs on the event loop exactly like an
+    # async def — the registration roots it
+    findings = lint(
+        """
+        import time
+
+        class Server:
+            def handle_status(self, request):
+                time.sleep(1)
+                return "ok"
+
+            async def start(self, app):
+                app.router.add_get("/status", self.handle_status)
+        """,
+        rules=["BTL001"],
+    )
+    assert rules_of(findings) == ["BTL001"]
+    assert "runs on the event loop" in findings[0].message
+    assert "route handler" in findings[0].message
+
+
+def test_context_periodic_task_callback_rooted_on_loop():
+    findings = lint(
+        """
+        import time
+        from baton_tpu.server.utils import PeriodicTask
+
+        class Server:
+            def _tick(self):
+                time.sleep(0.5)
+
+            async def start(self):
+                self._hb = PeriodicTask(self._tick, 1.0)
+        """,
+        rules=["BTL001"],
+    )
+    assert rules_of(findings) == ["BTL001"]
+    assert "Server.start()" in findings[0].message
+
+
+def test_context_thread_dispatch_exempts_blocking():
+    # a function dispatched ONLY to worker threads may legally block:
+    # no loop witness, no finding
+    findings = lint(
+        """
+        import asyncio, time
+
+        class Server:
+            def _work(self):
+                time.sleep(5)
+                with open("/tmp/x") as fh:
+                    return fh.read()
+
+            async def handler(self, request):
+                return await asyncio.to_thread(self._work)
+        """,
+        rules=["BTL001"],
+    )
+    assert findings == []
+
+
+def test_reflection_getattr_prefix_dispatch_resolved():
+    # getattr(self, "handle_" + kind) reaches every handle_* method
+    findings = lint(
+        """
+        import time
+
+        class Server:
+            def handle_flush(self, req):
+                time.sleep(1)
+
+            async def dispatch(self, kind, req):
+                return getattr(self, "handle_" + kind)(req)
+        """,
+        rules=["BTL001"],
+    )
+    assert rules_of(findings) == ["BTL001"]
+    assert "time.sleep" in findings[0].message
+
+
+def test_dispatch_table_dict_literal_resolved():
+    findings = lint(
+        """
+        import time
+
+        class Server:
+            def _on_flush(self, req):
+                time.sleep(1)
+
+            async def dispatch(self, kind, req):
+                table = {"flush": self._on_flush}
+                return table[kind](req)
+        """,
+        rules=["BTL001"],
+    )
+    assert rules_of(findings) == ["BTL001"]
+
+
+# ----------------------------------------------------------------------
+# loop-sensitive staleness: BTL003 / BTL004 across loop iterations
+
+
+def test_btl003_snapshot_hoisted_above_loop_flagged_loop_carried():
+    # each single iteration reads the snapshot BEFORE its own await,
+    # so a loop-blind pass sees nothing; only the repass (entering
+    # with the state the first pass left) catches iterations 2+
+    findings = lint(
+        """
+        class Manager:
+            async def pump(self, name):
+                st = self._rounds.get(name)
+                while True:
+                    st.mark_clean()
+                    await self.flush()
+        """,
+        rules=["BTL003"],
+    )
+    assert rules_of(findings) == ["BTL003"]
+    assert "loop-carried" in findings[0].message
+
+
+def test_btl003_reread_inside_loop_passes():
+    findings = lint(
+        """
+        class Manager:
+            async def pump(self, name):
+                while True:
+                    st = self._rounds.get(name)
+                    st.mark_clean()
+                    await self.flush()
+        """,
+        rules=["BTL003"],
+    )
+    assert findings == []
+
+
+def test_btl003_loop_without_suspension_not_repassed():
+    findings = lint(
+        """
+        class Manager:
+            async def pump(self, name):
+                st = self._rounds.get(name)
+                for x in self.items:
+                    st.mark_clean()
+        """,
+        rules=["BTL003"],
+    )
+    assert findings == []
+
+
+def test_btl004_write_back_in_suspending_loop_flagged_loop_carried():
+    # write-before-await: a single iteration never writes through a
+    # stale value, but the snapshot is stale on every later iteration
+    findings = lint(
+        """
+        class Manager:
+            async def drain(self):
+                waiters = self._waiters
+                for w in range(3):
+                    self._waiters = waiters + [w]
+                    await self.flush()
+        """,
+        rules=["BTL004"],
+    )
+    assert rules_of(findings) == ["BTL004"]
+    assert "loop-carried" in findings[0].message
+
+
+def test_btl004_reread_each_iteration_passes():
+    findings = lint(
+        """
+        class Manager:
+            async def drain(self):
+                for w in range(3):
+                    waiters = self._waiters
+                    self._waiters = waiters + [w]
+                    await self.flush()
+        """,
+        rules=["BTL004"],
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# BTL005 — cross-context state races (fold-lane frozen regression)
+
+
+_FOLD_LANE_RACY = """
+    import asyncio
+    import numpy as np
+
+    class Experiment:
+        def __init__(self, pipe):
+            self._pipe = pipe
+            self._acc = None
+
+        async def handle_update(self, request, tensors, n):
+            acc = self._acc
+
+            def fold():
+                acc.add(tensors, n)
+
+            await self._pipe.submit_fold(0, fold)
+
+        async def simulate(self, sd, n):
+            self._acc.add(sd, n)
+"""
+
+
+def test_btl005_frozen_fold_lane_regression():
+    # frozen pre-fix shape of server/http_manager.py: the fold lane
+    # thread and the loop-side simulated cohort both add() into the
+    # same accumulator with no common threading.Lock
+    findings = lint(_FOLD_LANE_RACY, rules=["BTL005"])
+    assert rules_of(findings) == ["BTL005"]
+    assert "THREAD context" in findings[0].message
+    assert "threading.Lock" in findings[0].message
+
+
+def test_btl005_shared_threading_lock_passes():
+    findings = lint(
+        """
+        import asyncio, threading
+
+        class Experiment:
+            def __init__(self, pipe):
+                self._pipe = pipe
+                self._acc = None
+                self._acc_lock = threading.Lock()
+
+            async def handle_update(self, request, tensors, n):
+                acc = self._acc
+
+                def fold():
+                    with self._acc_lock:
+                        acc.add(tensors, n)
+
+                await self._pipe.submit_fold(0, fold)
+
+            async def simulate(self, sd, n):
+                with self._acc_lock:
+                    self._acc.add(sd, n)
+        """,
+        rules=["BTL005"],
+    )
+    assert findings == []
+
+
+def test_btl005_asyncio_lock_does_not_count():
+    # an asyncio.Lock excludes coroutines from each other; a worker
+    # thread never awaits it, so it cannot guard this pair
+    findings = lint(
+        """
+        import asyncio
+
+        class Experiment:
+            def __init__(self, pipe):
+                self._pipe = pipe
+                self._acc = None
+                self._lock = asyncio.Lock()
+
+            async def handle_update(self, request, tensors, n):
+                acc = self._acc
+
+                def fold():
+                    acc.add(tensors, n)
+
+                await self._pipe.submit_fold(0, fold)
+
+            async def simulate(self, sd, n):
+                async with self._lock:
+                    self._acc.add(sd, n)
+        """,
+        rules=["BTL005"],
+    )
+    assert rules_of(findings) == ["BTL005"]
+
+
+def test_btl005_disjoint_leaf_paths_pass():
+    # the edge.py discipline: the fold thread owns r.acc, the loop owns
+    # r.contributors — disjoint leaves of the same root never conflict
+    findings = lint(
+        """
+        import asyncio
+
+        class Edge:
+            async def ingest(self, cid, entry, tensors, n):
+                r = self._round
+
+                def fold():
+                    r.acc.add(tensors, n)
+
+                r.contributors[cid] = entry
+                await self._pipe.submit_fold(0, fold)
+        """,
+        rules=["BTL005"],
+    )
+    assert findings == []
+
+
+def test_btl005_scoped_outside_server_passes():
+    findings = lint(
+        _FOLD_LANE_RACY, path="baton_tpu/core/fixture.py", rules=["BTL005"]
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# BTL006 — asyncio primitives touched from thread context
+
+
+def test_btl006_event_set_from_thread_flagged():
+    findings = lint(
+        """
+        import asyncio
+
+        class Worker:
+            def __init__(self):
+                self._done = asyncio.Event()
+
+            def _work(self):
+                self._done.set()
+
+            async def run(self):
+                await asyncio.to_thread(self._work)
+        """,
+        rules=["BTL006"],
+    )
+    assert rules_of(findings) == ["BTL006"]
+    assert "call_soon_threadsafe" in findings[0].message
+
+
+def test_btl006_call_soon_threadsafe_passes():
+    findings = lint(
+        """
+        import asyncio
+
+        class Worker:
+            def __init__(self, loop):
+                self._done = asyncio.Event()
+                self._loop = loop
+
+            def _work(self):
+                self._loop.call_soon_threadsafe(self._done.set)
+
+            async def run(self):
+                await asyncio.to_thread(self._work)
+        """,
+        rules=["BTL006"],
+    )
+    assert findings == []
+
+
+def test_btl006_loop_affine_call_from_thread_flagged():
+    findings = lint(
+        """
+        import asyncio
+
+        class Worker:
+            def __init__(self, loop):
+                self._loop = loop
+
+            def _work(self, coro):
+                self._loop.create_task(coro)
+
+            async def run(self, coro):
+                await asyncio.to_thread(self._work, coro)
+        """,
+        rules=["BTL006"],
+    )
+    assert rules_of(findings) == ["BTL006"]
+    assert "loop-affine" in findings[0].message
+
+
+def test_btl006_set_on_loop_passes():
+    findings = lint(
+        """
+        import asyncio
+
+        class Worker:
+            def __init__(self):
+                self._done = asyncio.Event()
+
+            async def run(self):
+                self._done.set()
+        """,
+        rules=["BTL006"],
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# BTL007 — entry-point reachability (dead code)
+
+
+def test_btl007_orphaned_private_helper_flagged():
+    findings = lint(
+        """
+        class Server:
+            def _orphan(self):
+                return 1
+
+            async def handle(self, request):
+                return "ok"
+        """,
+        rules=["BTL007"],
+    )
+    assert rules_of(findings) == ["BTL007"]
+    assert "_orphan" in findings[0].message
+
+
+def test_btl007_route_registration_roots_handler_chain():
+    findings = lint(
+        """
+        class Server:
+            def _helper(self):
+                return 1
+
+            def _handler(self, request):
+                return self._helper()
+
+            async def start(self, app):
+                app.router.add_get("/x", self._handler)
+        """,
+        rules=["BTL007"],
+    )
+    assert findings == []
+
+
+def test_btl007_callback_passed_by_value_is_live():
+    findings = lint(
+        """
+        class Server:
+            def _score(self, x):
+                return x + 1
+
+            async def handle(self, request, xs):
+                return list(map(self._score, xs))
+        """,
+        rules=["BTL007"],
+    )
+    assert findings == []
+
+
+def test_btl007_allow_suppression_works():
+    findings = lint(
+        """
+        class Server:
+            def _kept(self):  # batonlint: allow[BTL007]
+                return 1
+        """,
+        rules=["BTL007"],
+    )
+    assert findings == []
+
+
+def test_btl007_public_functions_are_roots():
+    findings = lint(
+        """
+        def helper():
+            return 1
+        """,
+        rules=["BTL007"],
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------------------
+# fingerprints + baseline diff mode
+
+
+def test_fingerprints_stable_across_line_shifts():
+    from baton_tpu.analysis.engine import finding_fingerprints
+
+    src = """
+    import time
+
+    async def handler(request):
+        time.sleep(1)
+    """
+    shifted = "\n\n\n" + textwrap.dedent(src)
+    f1 = lint(src, rules=["BTL001"])
+    r2 = run_source(shifted, path=SERVER_PATH, rules=["BTL001"])
+    assert f1[0].line != r2[0].line
+    assert finding_fingerprints(f1) == finding_fingerprints(r2)
+
+
+def test_apply_baseline_drops_known_findings():
+    from baton_tpu.analysis.engine import (
+        apply_baseline, finding_fingerprints,
+    )
+
+    report = Report()
+    run_source(
+        textwrap.dedent(
+            """
+            import time
+
+            async def handler(request):
+                time.sleep(1)
+                time.sleep(2)
+            """
+        ),
+        path=SERVER_PATH,
+        rules=["BTL001"],
+        report=report,
+    )
+    assert len(report.findings) == 2
+    fps = finding_fingerprints(report.findings)
+    apply_baseline(report, {fps[0]})
+    assert len(report.findings) == 1
+    assert report.baselined == 1
+    assert report.clean is False
+
+
+def test_cli_baseline_roundtrip(tmp_path, capsys):
+    import json as _json
+
+    from baton_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "server" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "import time\n\nasync def f(request):\n    time.sleep(1)\n"
+    )
+    base = tmp_path / "baseline.json"
+    assert main(["--write-baseline", str(base), str(bad)]) == 0
+    capsys.readouterr()
+    doc = _json.loads(base.read_text())
+    assert doc["version"] == 1 and len(doc["fingerprints"]) == 1
+    # same findings + baseline -> clean exit
+    assert main(["--baseline", str(base), str(bad)]) == 0
+    capsys.readouterr()
+    # a NEW finding is not masked by the baseline
+    bad.write_text(
+        "import time, pickle\n\nasync def f(request):\n"
+        "    time.sleep(1)\n    pickle.loads(b'x')\n"
+    )
+    assert main(["--baseline", str(base), str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "pickle.loads" in out
+
+
+def test_sarif_carries_partial_fingerprints(tmp_path, capsys):
+    import json as _json
+
+    from baton_tpu.analysis.__main__ import main
+
+    bad = tmp_path / "server" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "async def f(request):\n    return await request.read()\n"
+    )
+    out = tmp_path / "report.sarif"
+    assert main(["--sarif", str(out), str(bad)]) == 1
+    capsys.readouterr()
+    doc = _json.loads(out.read_text())
+    result = doc["runs"][0]["results"][0]
+    assert "batonlintFingerprint/v1" in result["partialFingerprints"]
 
 
 # ----------------------------------------------------------------------
